@@ -1,0 +1,31 @@
+"""distributed_groth16_tpu — a TPU-native collaborative Groth16 proving
+framework (JAX/XLA/Pallas), providing the capabilities of the reference
+zkSaaS prover (zkHubHQ/distributed-groth16): packed secret sharing, star
+collectives, distributed NTT/MSM kernels, and the Groth16 prover/service
+stack — re-designed for TPU meshes.
+
+Layer map (mirrors SURVEY.md §1):
+    ops/       field arithmetic, NTT, curve ops, MSM   (device kernels)
+    parallel/  net collectives, PSS, d_fft/d_msm/d_pp  (the "mpc-net"+"dist-primitives" role)
+    models/    groth16 prover/setup/verifier           (the "groth16" crate role)
+    frontend/  circom r1cs/zkey/wtns readers, witness  (the "ark-circom" role)
+    api/, cli  HTTP proving service + client           (the "mpc-api"/"zk-cli" role)
+"""
+
+import os
+
+import jax
+
+# Persistent compilation cache: our kernels are built from deep uint32 limb
+# graphs; caching compiled executables across processes matters for tests,
+# benches and the service alike.
+_cache_dir = os.environ.get(
+    "DG16_JAX_CACHE", os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+)
+try:
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # pragma: no cover - older jax without these flags
+    pass
+
+__version__ = "0.1.0"

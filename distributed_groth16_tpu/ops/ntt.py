@@ -1,0 +1,166 @@
+"""Radix-2 NTT over BN254 Fr for JAX/TPU, matching ark-poly's
+Radix2EvaluationDomain semantics (the reference's FFT substrate for both packed
+secret sharing — secret-sharing/src/pss.rs:39-47 — and the distributed FFT,
+dist-primitives/src/dfft/mod.rs).
+
+A `JaxDomain(size, offset)` evaluates polynomials at offset * w^i where
+w = g^((r-1)/size), g = 5 (arkworks Fr::GENERATOR). Data layout: coefficient /
+evaluation vectors are (..., n, 16) uint32 Montgomery limb tensors.
+
+XLA-friendliness: the transform is a single shape-uniform butterfly body run
+under `lax.fori_loop` over the log2(n) stages — twiddles are looked up from one
+dense table of the n-th roots of unity by index arithmetic — so the compiled
+graph size is independent of n and a domain of any size reuses one compiled
+butterfly per batch shape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .constants import FR_GENERATOR, FR_TWO_ADICITY, N_LIMBS, R
+from .field import fr
+from .refmath import finv
+
+
+def bitrev_perm(n: int) -> np.ndarray:
+    """Bit-reversal permutation indices (matches dfft/mod.rs:258-271)."""
+    logn = n.bit_length() - 1
+    idx = np.arange(n)
+    out = np.zeros(n, dtype=np.int32)
+    for b in range(logn):
+        out |= ((idx >> b) & 1) << (logn - 1 - b)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("logn", "inverse"))
+def _ntt_core(x, perm, wpows, logn: int, inverse: bool = False):
+    """DIT radix-2 NTT with dense root table.
+
+    x:     (..., n, 16) Montgomery uint32
+    perm:  (n,) int32 bit-reversal permutation
+    wpows: (n, 16) Montgomery powers w^0..w^{n-1} of the size-n FORWARD root;
+           the inverse transform indexes it as w^{-k} = wpows[(n-k) mod n].
+    """
+    F = fr()
+    n = x.shape[-2]
+    x = jnp.take(x, perm, axis=-2)
+    j = jnp.arange(n, dtype=jnp.int32)
+
+    def stage(s, x):
+        span = jnp.int32(1) << s
+        # butterfly partners: lo has bit s clear, hi has bit s set
+        lo_idx = j & ~span
+        hi_idx = j | span
+        # twiddle for lane j: wspan^(j mod span) with wspan = w^(n/(2*span))
+        k = (j & (span - 1)) * (jnp.int32(n) >> (s + 1))
+        if inverse:
+            k = (jnp.int32(n) - k) & jnp.int32(n - 1)
+        w = jnp.take(wpows, k, axis=0)
+        lo = jnp.take(x, lo_idx, axis=-2)
+        hi = jnp.take(x, hi_idx, axis=-2)
+        t = F.mul(hi, w)
+        is_lo = (j & span) == 0
+        return jnp.where(is_lo[:, None], F.add(lo, t), F.sub(lo, t))
+
+    return jax.lax.fori_loop(0, logn, stage, x)
+
+
+class JaxDomain:
+    """Device-side radix-2 evaluation domain over Fr (ark semantics)."""
+
+    def __init__(self, size: int, offset: int = 1):
+        assert size & (size - 1) == 0 and size > 0
+        assert size <= (1 << FR_TWO_ADICITY)
+        self.size = size
+        self.logn = size.bit_length() - 1
+        self.offset = offset % R
+        self.group_gen = pow(FR_GENERATOR, (R - 1) // size, R)
+        self.group_gen_inv = finv(self.group_gen, R)
+        F = fr()
+        self._perm = jnp.asarray(bitrev_perm(size))
+        self._wpows = _powers_device(self.group_gen, size)
+        self._size_inv = F.encode([finv(size, R)])[0]
+        if self.offset != 1:
+            off_inv = finv(self.offset, R)
+            self._off_pows = _powers_device(self.offset, size)
+            self._off_inv_pows = _powers_device(off_inv, size)
+        else:
+            self._off_pows = None
+            self._off_inv_pows = None
+
+    def elements(self) -> list[int]:
+        out, acc = [], self.offset
+        for _ in range(self.size):
+            out.append(acc)
+            acc = acc * self.group_gen % R
+        return out
+
+    def fft(self, coeffs):
+        """Evaluate: (..., k<=n, 16) coeffs -> (..., n, 16) evals."""
+        F = fr()
+        x = _zpad(coeffs, self.size)
+        if self._off_pows is not None:
+            x = F.mul(x, self._off_pows)
+        return _ntt_core(x, self._perm, self._wpows, self.logn)
+
+    def ifft(self, evals):
+        """Interpolate: (..., k<=n, 16) evals -> (..., n, 16) coeffs."""
+        F = fr()
+        x = _zpad(evals, self.size)
+        x = _ntt_core(x, self._perm, self._wpows, self.logn, inverse=True)
+        x = F.mul(x, self._size_inv)
+        if self._off_inv_pows is not None:
+            x = F.mul(x, self._off_inv_pows)
+        return x
+
+    def get_coset(self, offset: int) -> "JaxDomain":
+        return domain(self.size, offset * self.offset % R)
+
+
+def _zpad(x, n):
+    k = x.shape[-2]
+    assert k <= n, f"input length {k} exceeds domain size {n}"
+    if k == n:
+        return x
+    pad = [(0, 0)] * (x.ndim - 2) + [(0, n - k), (0, 0)]
+    return jnp.pad(x, pad)
+
+
+def _powers(base: int, n: int) -> list[int]:
+    out, acc = [], 1
+    for _ in range(n):
+        out.append(acc)
+        acc = acc * base % R
+    return out
+
+
+def _powers_device(base: int, n: int) -> jnp.ndarray:
+    """(n, 16) table of base^0..base^{n-1}, built with O(log n) device muls.
+
+    Host work is O(1) (encode the base once); the table doubles on device:
+    [b^0..b^{k-1}] -> [b^0..b^{2k-1}] via one batched multiply by b^k.
+    """
+    F = fr()
+    logn = max(1, (n - 1).bit_length())
+    # base^(2^b) for each bit, via repeated squaring on a single element —
+    # all muls here share the (1, 16) shape so only one executable compiles.
+    bit_pows = [F.encode([base % R])]
+    for _ in range(logn - 1):
+        bit_pows.append(F.mul(bit_pows[-1], bit_pows[-1]))
+    # tbl[k] = prod_{b: bit b of k set} base^(2^b); logn muls of shape (n, 16).
+    k = jnp.arange(n, dtype=jnp.uint32)
+    tbl = jnp.broadcast_to(jnp.asarray(F.one), (n, N_LIMBS))
+    for b in range(logn):
+        hit = ((k >> b) & 1) == 1
+        tbl = jnp.where(hit[:, None], F.mul(tbl, bit_pows[b]), tbl)
+    return tbl
+
+
+@functools.cache
+def domain(size: int, offset: int = 1) -> JaxDomain:
+    return JaxDomain(size, offset)

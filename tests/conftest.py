@@ -1,0 +1,26 @@
+"""Test configuration: force a CPU backend with 8 virtual devices.
+
+Multi-party/multi-chip code is tested on a virtual 8-device CPU mesh
+(mirroring the reference's LocalTestNet strategy of simulating n parties in
+one process — mpc-net/src/multi.rs:227). Real-TPU runs happen only via
+bench.py / __graft_entry__.py.
+
+In this environment a sitecustomize hook may import jax at interpreter
+startup (before conftest runs), so editing os.environ here is too late for
+anything jax reads at import time. jax.config.update works post-import as
+long as no backend has initialized yet, and XLA_FLAGS is read at CPU-backend
+init, so setting it here is still in time.
+"""
+
+import os
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

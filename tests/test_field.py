@@ -1,0 +1,59 @@
+"""Field arithmetic kernels vs pure-Python bigint ground truth."""
+
+import random
+
+import pytest
+
+from distributed_groth16_tpu.ops import refmath as rm
+from distributed_groth16_tpu.ops.constants import Q, R
+from distributed_groth16_tpu.ops.field import fq, fq2, fr
+
+random.seed(1234)
+
+
+@pytest.mark.parametrize("field,p", [(fr, R), (fq, Q)])
+def test_ring_ops(field, p):
+    F = field()
+    xs = [random.randrange(p) for _ in range(32)] + [0, 1, p - 1, p - 1]
+    ys = [random.randrange(p) for _ in range(32)] + [0, p - 1, p - 1, 1]
+    X, Y = F.encode(xs), F.encode(ys)
+    assert list(F.decode(X)) == xs
+    assert list(F.decode(F.mul(X, Y))) == [x * y % p for x, y in zip(xs, ys)]
+    assert list(F.decode(F.add(X, Y))) == [(x + y) % p for x, y in zip(xs, ys)]
+    assert list(F.decode(F.sub(X, Y))) == [(x - y) % p for x, y in zip(xs, ys)]
+    assert list(F.decode(F.neg(X))) == [(-x) % p for x in xs]
+
+
+def test_inversion():
+    F = fr()
+    xs = [random.randrange(R) for _ in range(8)]
+    X = F.encode(xs)
+    assert list(F.decode(F.inv(X))) == [rm.finv(x, R) for x in xs]
+    mixed = [0, 5, 0, 7, random.randrange(R)]
+    got = list(F.decode(F.batch_inv(F.encode(mixed))))
+    assert got == [0 if x == 0 else rm.finv(x, R) for x in mixed]
+
+
+def test_mont_conversion_device_side():
+    F = fr()
+    xs = [random.randrange(R) for _ in range(4)]
+    X = F.encode(xs)
+    std = F.from_mont(X)
+    back = F.to_mont(std)
+    assert list(F.decode(back)) == xs
+
+
+def test_fq2_ops():
+    F2 = fq2()
+    a = [(random.randrange(Q), random.randrange(Q)) for _ in range(8)]
+    b = [(random.randrange(Q), random.randrange(Q)) for _ in range(8)]
+    A, B = F2.encode(a), F2.encode(b)
+    got = F2.decode(F2.mul(A, B))
+    for i in range(8):
+        assert tuple(int(v) for v in got[i]) == rm.fq2_mul(a[i], b[i])
+    got = F2.decode(F2.sqr(A))
+    for i in range(8):
+        assert tuple(int(v) for v in got[i]) == rm.fq2_sq(a[i])
+    got = F2.decode(F2.inv(A))
+    for i in range(8):
+        assert tuple(int(v) for v in got[i]) == rm.fq2_inv(a[i])
