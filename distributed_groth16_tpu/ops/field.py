@@ -91,18 +91,28 @@ class PrimeField:
         return z, o
 
     # -- carry machinery ------------------------------------------------------
+    #
+    # Carry/borrow chains are `lax.scan`s over the limb axis: the body is one
+    # vector op over the whole batch, so the traced graph stays tiny (XLA
+    # compile time of composite kernels was dominated by unrolled chains) and
+    # the compiled loop runs limb-major with good locality.
 
     @staticmethod
-    def _carry_propagate(v):
+    def _carry_propagate_limb_major(vt):
+        """Carry propagation of a (k,) + batch limb-major lazy accumulator."""
+
+        def step(c, x):
+            t = x + c
+            return t >> LIMB_BITS, t & _MASK
+
+        _, out = jax.lax.scan(step, jnp.zeros(vt.shape[1:], jnp.uint32), vt)
+        return out
+
+    @classmethod
+    def _carry_propagate(cls, v):
         """Full carry propagation of a (..., k)-limb lazy accumulator."""
-        k = v.shape[-1]
-        out = []
-        c = jnp.zeros(v.shape[:-1], jnp.uint32)
-        for j in range(k):
-            t = v[..., j] + c
-            out.append(t & _MASK)
-            c = t >> LIMB_BITS
-        return jnp.stack(out, axis=-1)
+        vt = jnp.moveaxis(v, -1, 0)
+        return jnp.moveaxis(cls._carry_propagate_limb_major(vt), 0, -1)
 
     @staticmethod
     def _sub_limbs(a, b):
@@ -111,13 +121,19 @@ class PrimeField:
         Both inputs carried (limbs <= LIMB_MASK); borrow detection relies on
         uint32 wraparound setting the top bit.
         """
-        borrow = jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape)[:-1], jnp.uint32)
-        limbs = []
-        for j in range(N_LIMBS):
-            t = a[..., j] - b[..., j] - borrow
-            limbs.append(t & _MASK)
-            borrow = t >> 31  # top bit set iff the subtraction went negative
-        return jnp.stack(limbs, axis=-1), borrow
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        at = jnp.moveaxis(jnp.broadcast_to(a, shape), -1, 0)
+        bt = jnp.moveaxis(jnp.broadcast_to(b, shape), -1, 0)
+
+        def step(borrow, xs):
+            x, y = xs
+            t = x - y - borrow
+            return t >> 31, t & _MASK  # top bit set iff subtraction went negative
+
+        borrow, out = jax.lax.scan(
+            step, jnp.zeros(shape[:-1], jnp.uint32), (at, bt)
+        )
+        return jnp.moveaxis(out, 0, -1), borrow
 
     def _sub_p_if_geq(self, a):
         """a - p if a >= p else a (a < 2p, 16 limbs, carried)."""
@@ -143,33 +159,39 @@ class PrimeField:
         return self.sub(z, a)
 
     def mul(self, a, b):
-        """Montgomery product abR^{-1} mod p, lazy-carry CIOS."""
+        """Montgomery product abR^{-1} mod p, lazy-carry CIOS.
+
+        The 16 CIOS iterations run under `lax.scan` with a shape-uniform
+        body, so the traced graph is one butterfly-sized block regardless of
+        how many muls a caller composes — this keeps XLA compile times of big
+        composite kernels (curve adds, NTT stages) tractable.
+        """
         shape = jnp.broadcast_shapes(a.shape, b.shape)
-        a = jnp.broadcast_to(a, shape)
-        b = jnp.broadcast_to(b, shape)
         batch = shape[:-1]
-        pad_lo = [(0, 0)] * len(batch) + [(0, 1)]
-        pad_hi = [(0, 0)] * len(batch) + [(1, 0)]
-        q = jnp.asarray(self.p_limbs)
-        v = jnp.zeros(batch + (N_LIMBS + 1,), jnp.uint32)
-        for i in range(N_LIMBS):
-            prod = a[..., i : i + 1] * b
+        # limb-major layout inside the kernel: (limb,) + batch
+        at = jnp.moveaxis(jnp.broadcast_to(a, shape), -1, 0)
+        bt = jnp.moveaxis(jnp.broadcast_to(b, shape), -1, 0)
+        qt = jnp.asarray(self.p_limbs).reshape((N_LIMBS,) + (1,) * len(batch))
+        pad_lo = [(0, 1)] + [(0, 0)] * len(batch)
+        pad_hi = [(1, 0)] + [(0, 0)] * len(batch)
+        zeros_head = jnp.zeros((1,) + batch, jnp.uint32)
+
+        def step(v, ai):
+            prod = ai[None] * bt
             v = v + jnp.pad(prod & _MASK, pad_lo) + jnp.pad(prod >> LIMB_BITS, pad_hi)
-            m = (v[..., 0] * self.n0) & _MASK
-            qp = m[..., None] * q
+            m = (v[0] * self.n0) & _MASK
+            qp = m[None] * qt
             v = v + jnp.pad(qp & _MASK, pad_lo) + jnp.pad(qp >> LIMB_BITS, pad_hi)
             # limb 0 is now ≡ 0 mod 2^16; shift right one limb, pushing its
             # high bits into the new limb 0.
-            carry0 = (v[..., 0] >> LIMB_BITS)[..., None]
-            v = jnp.concatenate(
-                [
-                    v[..., 1:2] + carry0,
-                    v[..., 2:],
-                    jnp.zeros(batch + (1,), jnp.uint32),
-                ],
-                axis=-1,
+            carry0 = v[0] >> LIMB_BITS
+            return (
+                jnp.concatenate([(v[1] + carry0)[None], v[2:], zeros_head], axis=0),
+                None,
             )
-        v = self._carry_propagate(v)[..., :N_LIMBS]
+
+        v, _ = jax.lax.scan(step, jnp.zeros((N_LIMBS + 1,) + batch, jnp.uint32), at)
+        v = jnp.moveaxis(self._carry_propagate_limb_major(v)[:N_LIMBS], 0, -1)
         return self._sub_p_if_geq(v)
 
     def sqr(self, a):
