@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .constants import G1_B, G2_B, N_LIMBS, Q
-from .field import Fq2Ops, PrimeField, fq, fq2
+from .field import fq, fq2
 
 
 class CurvePoints:
@@ -39,6 +39,7 @@ class CurvePoints:
         self.elem_shape = elem_shape
         self.coord_axes = len(elem_shape)
         b3_int = self._triple_int(b)
+        self.b = self._const(b)  # b in Montgomery form, device const
         self.b3 = self._const(b3_int)  # 3*b in Montgomery form, device const
         z, o = field.consts(())
         self._zero_c, self._one_c = z, o
@@ -53,8 +54,6 @@ class CurvePoints:
         return 3 * b % Q
 
     def _const(self, v):
-        if isinstance(v, tuple):
-            return self.F.encode([v])[0]
         return self.F.encode([v])[0]
 
     # -- construction / conversion -------------------------------------------
@@ -85,8 +84,8 @@ class CurvePoints:
         arr = np.asarray(arr, dtype=object)
         batch = arr.shape[: arr.ndim - 1 - (self.coord_axes - 1)]
         # arr has shape batch + (3,) [+ (2,)]
-        out = []
         flat = arr.reshape((-1, 3) + ((2,) if self.coord_axes == 2 else ()))
+        out = []
         from .refmath import finv, fq2_inv, fq2_mul
 
         for row in flat:
@@ -108,9 +107,12 @@ class CurvePoints:
                     out.append((fq2_mul(x, zi), fq2_mul(y, zi)))
         if batch == ():
             return out[0]
-        return np.array(out, dtype=object).reshape(batch).tolist() if len(
-            batch
-        ) > 1 else out
+        if len(batch) == 1:
+            return out
+        obj = np.empty(len(out), dtype=object)
+        for i, v in enumerate(out):
+            obj[i] = v
+        return obj.reshape(batch).tolist()
 
     def infinity(self, shape=()):
         """(0 : 1 : 0) broadcast to the given batch shape."""
@@ -253,13 +255,24 @@ class CurvePoints:
     def to_affine(self, pts):
         """Projective -> affine (x, y) coords on device; infinity -> (0, 0).
 
-        Returns (..., 2) + elem_shape. Uses one batched field inversion.
+        Returns (..., 2) + elem_shape. One batched (Montgomery-trick) field
+        inversion over the flattened batch: ~3n muls + one Fermat exp.
         """
         X, Y, Z = self._coords(pts)
+        batch = Z.shape[: Z.ndim - self.coord_axes]
         if self.coord_axes == 1:
-            zinv = self.F.inv(Z)
+            zinv = self.F.batch_inv(Z.reshape((-1, N_LIMBS))).reshape(Z.shape)
         else:
-            zinv = self.F.inv(Z)
+            # Fq2 batch inverse via the norm map: 1/(a0+a1 u) =
+            # (a0 - a1 u) / (a0^2 + a1^2), with the Fq norms batch-inverted.
+            f = self.F.fq
+            a0 = Z[..., 0, :].reshape((-1, N_LIMBS))
+            a1 = Z[..., 1, :].reshape((-1, N_LIMBS))
+            norm = f.add(f.sqr(a0), f.sqr(a1))
+            ninv = f.batch_inv(norm)
+            zinv = jnp.stack(
+                [f.mul(a0, ninv), f.neg(f.mul(a1, ninv))], axis=-2
+            ).reshape(batch + (2, N_LIMBS))
         x = self.F.mul(X, zinv)
         y = self.F.mul(Y, zinv)
         return jnp.stack([x, y], axis=-1 - self.coord_axes)
@@ -281,19 +294,8 @@ class CurvePoints:
         X, Y, Z = self._coords(p)
         lhs = F.mul(F.mul(Y, Y), Z)
         z3 = F.mul(F.mul(Z, Z), Z)
-        b = F.mul(self.b3, self._third())
-        rhs = F.add(F.mul(F.mul(X, X), X), F.mul(b, z3))
+        rhs = F.add(F.mul(F.mul(X, X), X), F.mul(self.b, z3))
         return F.eq(lhs, rhs)
-
-    @functools.cache
-    def _third(self):
-        """Montgomery 1/3 as a device const (to recover b from b3)."""
-        from .refmath import finv
-
-        inv3 = finv(3, Q)
-        if self.coord_axes == 1:
-            return self.F.encode([inv3])[0]
-        return self.F.encode([(inv3, 0)])[0]
 
     def eq(self, p, q):
         """Projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1."""
@@ -318,10 +320,11 @@ def g2() -> CurvePoints:
     return CurvePoints(fq2(), G2_B, (2, N_LIMBS))
 
 
-def scalar_bits(fr_field: PrimeField, scalars, nbits: int = 256) -> jnp.ndarray:
+def scalar_bits(scalars, nbits: int = 256) -> jnp.ndarray:
     """Standard-form scalar limb array (..., 16) -> bit array (..., nbits).
 
-    Scalars must be in standard (non-Montgomery) form.
+    Scalars must be in standard (non-Montgomery) form; the decomposition is
+    pure limb shifting, independent of any field.
     """
     from .constants import LIMB_BITS
 

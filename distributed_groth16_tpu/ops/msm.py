@@ -1,0 +1,131 @@
+"""Multi-scalar multiplication (Pippenger) for BN254 G1/G2 on JAX/TPU.
+
+Computes sum_i s_i * P_i — the dominant kernel of the Groth16 prover (the
+reference's per-party hot loop is arkworks `G::msm` at
+dist-primitives/src/dmsm/mod.rs:82, called five times per proof:
+S*a, V*a, W*ax, U*h, H*a — groth16/src/prove.rs).
+
+TPU-first design — no scatter, no data-dependent control flow:
+
+  * windowed digits: each 254-bit scalar is split into W = 256/c digits of
+    c bits (c | 16 so digits never straddle the uint16 limbs of ops/field.py).
+  * bucket accumulation WITHOUT scatter: per window, points are sorted by
+    digit (one argsort of int32 keys) and an inclusive prefix sum of the
+    sorted points is taken under the branchless group law
+    (`lax.associative_scan` — log-depth, fully batched adds). The sum of
+    bucket b is then prefix[end_b] - prefix[end_{b-1}], and the classic
+    weighted-bucket identity
+        sum_b b * S_b = sum_{k=1..B-1} (T - C_{k-1})
+    (T = sum of all points, C_j = prefix sum through bucket j) turns the
+    whole window reduction into B batched complete-adds + one tree sum.
+  * window combine is Horner: c doublings + 1 add per window.
+
+Complete RCB16 formulas (ops/curve.py) make every add branchless, so the
+entire MSM is one `jit`-compiled program of static shape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .constants import LIMB_BITS, N_LIMBS
+from .curve import CurvePoints, g1, g2
+
+# total scalar bits covered (BN254 Fr fits in 254 < 256)
+_SCALAR_BITS = 256
+
+
+def _digits_for_window(scalars, w, c: int):
+    """Extract the w-th c-bit digit of each scalar. scalars: (n, 16) standard
+    form; w may be traced. Returns (n,) int32 in [0, 2^c)."""
+    per_limb = LIMB_BITS // c
+    limb_idx = w // per_limb
+    shift = (w % per_limb) * c
+    limb = jax.lax.dynamic_index_in_dim(scalars, limb_idx, axis=-1, keepdims=False)
+    return ((limb >> shift) & ((1 << c) - 1)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _msm_jit(curve: CurvePoints, points, scalars, c: int):
+    n = points.shape[0]
+    B = 1 << c
+    W = _SCALAR_BITS // c
+    inf = curve.infinity()
+
+    def window_sum(w):
+        digits = _digits_for_window(scalars, w, c)
+        order = jnp.argsort(digits)
+        d_sorted = jnp.take(digits, order, axis=0)
+        p_sorted = jnp.take(points, order, axis=0)
+        prefix = jax.lax.associative_scan(curve.add, p_sorted, axis=0)
+        total = prefix[n - 1]
+        # C_j = sum of points with digit <= j, for j = 0..B-2
+        ends = jnp.searchsorted(d_sorted, jnp.arange(B - 1), side="right")
+        cum = curve.select(
+            ends > 0,
+            jnp.take(prefix, jnp.maximum(ends - 1, 0), axis=0),
+            jnp.broadcast_to(inf, (B - 1,) + inf.shape),
+        )
+        # sum_b b*S_b = sum_{j=0..B-2} (total - C_j)
+        terms = curve.add(jnp.broadcast_to(total, cum.shape), curve.neg(cum))
+        return curve.sum(terms, axis=0)
+
+    def body(i, acc):
+        w = W - 1 - i
+
+        def dbl(_, a):
+            return curve.double(a)
+
+        acc = jax.lax.fori_loop(0, c, dbl, acc)
+        return curve.add(acc, window_sum(w))
+
+    return jax.lax.fori_loop(0, W, body, inf)
+
+
+def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
+        chunk: int | None = None):
+    """sum_i scalars[i] * points[i].
+
+    points:  (n, 3) + elem_shape projective device points.
+    scalars: (n, 16) uint32 limbs in STANDARD (non-Montgomery) form.
+    window_bits: Pippenger window c (must divide 16); default auto.
+    chunk: process points in chunks of this size (bounds peak memory; MSM is
+           linear so chunk results just add).
+
+    Returns a single projective point (3,) + elem_shape.
+    """
+    n = points.shape[0]
+    assert scalars.shape[-1] == N_LIMBS and scalars.shape[0] == n
+    if window_bits is None:
+        window_bits = 8 if n >= 64 else 4
+    assert LIMB_BITS % window_bits == 0, "window must divide the 16-bit limb"
+    if chunk is None or chunk >= n:
+        return _msm_jit(curve, points, scalars, window_bits)
+    acc = curve.infinity()
+    for s in range(0, n, chunk):
+        part = _msm_jit(curve, points[s : s + chunk], scalars[s : s + chunk],
+                        window_bits)
+        acc = curve.add(acc, part)
+    return acc
+
+
+def msm_g1(points, scalars, **kw):
+    return msm(g1(), points, scalars, **kw)
+
+
+def msm_g2(points, scalars, **kw):
+    return msm(g2(), points, scalars, **kw)
+
+
+def encode_scalars_std(values) -> jnp.ndarray:
+    """Python ints -> (n, 16) standard-form uint32 limb array (host-side)."""
+    import numpy as np
+
+    from .constants import R, to_limbs
+
+    vals = [int(v) % R for v in values]
+    out = np.array([to_limbs(v) for v in vals], dtype=np.uint32)
+    return jnp.asarray(out)

@@ -59,7 +59,7 @@ class TestG1:
     def test_scalar_mul_and_sum(self):
         ks = _rand_scalars(4)
         base = g1().encode([G1_GENERATOR] * 4)
-        bits = scalar_bits(fr(), _std_limbs(ks))
+        bits = scalar_bits(_std_limbs(ks))
         out = g1().scalar_mul_bits(base, bits)
         assert g1().decode(out) == _g1_points(ks)
         tot = g1().sum(out, axis=0)
@@ -87,7 +87,7 @@ class TestG2:
     def test_scalar_mul(self):
         ks = _rand_scalars(2)
         base = g2().encode([G2_GENERATOR] * 2)
-        bits = scalar_bits(fr(), _std_limbs(ks))
+        bits = scalar_bits(_std_limbs(ks))
         out = g2().scalar_mul_bits(base, bits)
         assert g2().decode(out) == _g2_points(ks)
 
