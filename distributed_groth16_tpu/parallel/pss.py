@@ -1,0 +1,181 @@
+"""Packed secret sharing (PSS) over BN254 Fr for JAX/TPU.
+
+The sharding format of the whole framework: `l` secrets are packed into one
+degree-(t+l) polynomial and dealt as `n = 4l` shares (threshold `t = l-1`),
+exactly the zkSaaS scheme of the reference's secret-sharing crate
+(secret-sharing/src/pss.rs:13-148):
+
+  * shares    = evaluations on the size-n `share` domain,
+  * secrets   = evaluations on a coset (offset = Fr generator) of the
+                size-(l+t+1) `secret` domain,
+  * products  = evaluations on the size-2(l+t+1) `secret2` coset.
+
+pack   : IFFT on `secret` (zero-padded), FFT on `share`        (pss.rs:86-92)
+unpack : IFFT on `share`, truncate to 2l coeffs, FFT on `secret`, keep l
+                                                                (pss.rs:110-127)
+unpack2: IFFT on `share`, FFT on `secret2`, keep even indices of the first
+         2l entries                                             (pss.rs:131-148)
+
+All field-vector transforms run batched on device via ops/ntt.py (one tiny
+NTT per m/l chunk, vectorized over the chunk axis — the TPU-friendly shape).
+Group-element ("in the exponent") packing for the CRS exposes the same maps
+as precomputed l x n / n x l Fr matrices applied with one batched
+double-and-add ladder (dist-primitives/src/dmsm/mod.rs:50-68 semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import refmath as rm
+from ..ops.constants import FR_GENERATOR, R
+from ..ops.curve import CurvePoints, scalar_bits
+from ..ops.msm import encode_scalars_std
+from ..ops.ntt import domain
+
+
+class PackedSharingParams:
+    """PSS parameters and transforms for packing factor l (n = 4l parties)."""
+
+    def __init__(self, l: int):
+        assert l >= 1 and (l & (l - 1)) == 0, "packing factor must be a power of 2"
+        self.l = l
+        self.t = l - 1
+        self.n = 4 * l
+        assert self.n == 2 * (self.t + self.l + 1)
+        self.share = domain(self.n)
+        self.secret = domain(self.l + self.t + 1, offset=FR_GENERATOR)
+        self.secret2 = domain(2 * (self.l + self.t + 1), offset=FR_GENERATOR)
+        # host-side mirrors for matrix construction / ground truth
+        self.share_h = rm.Domain(self.n)
+        self.secret_h = rm.Domain(self.l + self.t + 1, offset=FR_GENERATOR)
+        self.secret2_h = rm.Domain(2 * (self.l + self.t + 1), offset=FR_GENERATOR)
+
+    # -- field-vector transforms (batched over leading axes) ------------------
+
+    def pack_from_public(self, secrets):
+        """(..., l, 16) secrets -> (..., n, 16) shares."""
+        assert secrets.shape[-2] == self.l
+        return self.share.fft(self.secret.ifft(secrets))
+
+    def pack_from_public_rand(self, secrets, rng: np.random.Generator):
+        """Packing with t+1 random filler points (pss.rs:72-82 semantics;
+        the reference uses a test rng — randomness only has to be dropped by
+        unpack, which truncates to l)."""
+        assert secrets.shape[-2] == self.l
+        fr = _fr()
+        rand = fr.encode(
+            rng.integers(0, 2**63, size=secrets.shape[:-2] + (self.t + 1,))
+        )
+        full = jnp.concatenate([secrets, rand], axis=-2)
+        return self.share.fft(self.secret.ifft(full))
+
+    def unpack(self, shares):
+        """(..., n, 16) degree-(t+l) shares -> (..., l, 16) secrets."""
+        assert shares.shape[-2] == self.n
+        coeffs = self.share.ifft(shares)[..., : self.secret.size, :]
+        return self.secret.fft(coeffs)[..., : self.l, :]
+
+    def unpack2(self, shares):
+        """(..., n, 16) degree-2(t+l) shares -> (..., l, 16) secrets."""
+        assert shares.shape[-2] == self.n
+        coeffs = self.share.ifft(shares)
+        evals = self.secret2.fft(coeffs)
+        return evals[..., : 2 * self.l : 2, :]
+
+    # -- linear maps as explicit Fr matrices (for group elements) ------------
+
+    @functools.cached_property
+    def pack_matrix(self) -> list[list[int]]:
+        """(n, l) ints: shares = M @ secrets."""
+        cols = []
+        for i in range(self.l):
+            e = [0] * self.l
+            e[i] = 1
+            coeffs = self.secret_h.ifft(e)
+            cols.append(self.share_h.fft(coeffs))
+        return [[cols[i][p] for i in range(self.l)] for p in range(self.n)]
+
+    @functools.cached_property
+    def unpack_matrix(self) -> list[list[int]]:
+        """(l, n) ints: secrets = M @ shares (degree t+l shares)."""
+        cols = []
+        for j in range(self.n):
+            e = [0] * self.n
+            e[j] = 1
+            coeffs = self.share_h.ifft(e)[: self.secret_h.size]
+            cols.append(self.secret_h.fft(coeffs)[: self.l])
+        return [[cols[j][i] for j in range(self.n)] for i in range(self.l)]
+
+    @functools.cached_property
+    def unpack2_matrix(self) -> list[list[int]]:
+        """(l, n) ints: secrets = M @ shares (degree 2(t+l) shares)."""
+        cols = []
+        for j in range(self.n):
+            e = [0] * self.n
+            e[j] = 1
+            coeffs = self.share_h.ifft(e)
+            evals = self.secret2_h.fft(coeffs)
+            cols.append(evals[: 2 * self.l : 2])
+        return [[cols[j][i] for j in range(self.n)] for i in range(self.l)]
+
+    # -- group-element ("in the exponent") transforms -------------------------
+
+    def _apply_point_matrix(self, curve: CurvePoints, mat, pts):
+        """out[..., o, :] = sum_i mat[o][i] * pts[..., i, :].
+
+        pts: (..., k) + point shape; mat: (o, k) ints. One 256-step
+        double-and-add ladder batched over (..., o, k), then a log-k tree sum.
+        """
+        o, k = len(mat), len(mat[0])
+        flat = [mat[a][b] for a in range(o) for b in range(k)]
+        bits = scalar_bits(encode_scalars_std(flat)).reshape(o, k, 256)
+        ax = pts.ndim - 2 - curve.coord_axes  # index of the k axis
+        batch = pts.shape[:ax]
+        p = jnp.expand_dims(pts, ax)  # (..., 1, k) + point
+        terms = curve.scalar_mul_bits(p, bits)  # (..., o, k) + point
+        return curve.sum(terms, axis=len(batch) + 1)
+
+    def packexp_from_public(self, curve: CurvePoints, pts):
+        """(..., l) + point -> (..., n) + point (dmsm/mod.rs:61-68)."""
+        return self._apply_point_matrix(curve, self.pack_matrix, pts)
+
+    def unpackexp(self, curve: CurvePoints, shares, degree2: bool = False):
+        """(..., n) + point -> (..., l) + point (dmsm/mod.rs:7-48)."""
+        mat = self.unpack2_matrix if degree2 else self.unpack_matrix
+        return self._apply_point_matrix(curve, mat, shares)
+
+
+@functools.cache
+def _fr():
+    from ..ops.field import fr
+
+    return fr()
+
+
+@functools.cache
+def pss(l: int) -> PackedSharingParams:
+    return PackedSharingParams(l)
+
+
+# ---------------------------------------------------------------------------
+# Host-side ground truth (pure ints) for differential tests
+# ---------------------------------------------------------------------------
+
+
+def pack_host(pp: PackedSharingParams, secrets: list[int]) -> list[int]:
+    assert len(secrets) == pp.l
+    return pp.share_h.fft(pp.secret_h.ifft(secrets))
+
+
+def unpack_host(pp: PackedSharingParams, shares: list[int]) -> list[int]:
+    coeffs = pp.share_h.ifft(shares)[: pp.secret_h.size]
+    return pp.secret_h.fft(coeffs)[: pp.l]
+
+
+def unpack2_host(pp: PackedSharingParams, shares: list[int]) -> list[int]:
+    coeffs = pp.share_h.ifft(shares)
+    return pp.secret2_h.fft(coeffs)[: 2 * pp.l : 2]

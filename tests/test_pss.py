@@ -1,0 +1,128 @@
+"""PSS tests mirroring the reference's secret-sharing/src/pss.rs:152-241
+(roundtrip, share-wise multiplication, randomized packing) plus the
+group-element packing of dmsm/mod.rs:100-193."""
+
+import random
+
+import numpy as np
+import pytest
+
+from distributed_groth16_tpu.ops import refmath as rm
+from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
+from distributed_groth16_tpu.ops.curve import g1
+from distributed_groth16_tpu.ops.field import fr
+from distributed_groth16_tpu.parallel.pss import (
+    PackedSharingParams,
+    pack_host,
+    unpack2_host,
+    unpack_host,
+)
+
+
+@pytest.mark.parametrize("l", [2, 4])
+def test_initialize(l):
+    pp = PackedSharingParams(l)
+    assert pp.t == l - 1 and pp.n == 4 * l
+    assert pp.share.size == pp.n
+    assert pp.secret.size == l + pp.t + 1
+    assert pp.secret2.size == 2 * (l + pp.t + 1)
+
+
+@pytest.mark.parametrize("l", [2, 4])
+def test_pack_unpack_roundtrip_device(l):
+    pp = PackedSharingParams(l)
+    F = fr()
+    rng = random.Random(17)
+    batch = 3
+    secrets = [[rng.randrange(R) for _ in range(l)] for _ in range(batch)]
+    shares = pp.pack_from_public(F.encode(secrets))
+    assert shares.shape == (batch, pp.n, 16)
+    back = F.decode(pp.unpack(shares))
+    assert [[int(x) for x in row] for row in back] == secrets
+    # cross-check device pack against host ground truth
+    host_shares = [pack_host(pp, s) for s in secrets]
+    dev_shares = F.decode(shares)
+    assert [[int(x) for x in row] for row in dev_shares] == host_shares
+
+
+def test_sharewise_multiplication():
+    """share(x) * share(y) unpacks (via unpack2) to x*y elementwise."""
+    l = 2
+    pp = PackedSharingParams(l)
+    F = fr()
+    rng = random.Random(5)
+    xs = [rng.randrange(R) for _ in range(l)]
+    ys = [rng.randrange(R) for _ in range(l)]
+    sx = pp.pack_from_public(F.encode([xs]))
+    sy = pp.pack_from_public(F.encode([ys]))
+    prod = F.mul(sx, sy)
+    back = F.decode(pp.unpack2(prod))[0]
+    assert [int(v) for v in back] == [x * y % R for x, y in zip(xs, ys)]
+    # host ground truth agrees
+    hx, hy = pack_host(pp, xs), pack_host(pp, ys)
+    hp = [a * b % R for a, b in zip(hx, hy)]
+    assert unpack2_host(pp, hp) == [x * y % R for x, y in zip(xs, ys)]
+
+
+def test_pack_rand_roundtrip():
+    l = 2
+    pp = PackedSharingParams(l)
+    F = fr()
+    rng = random.Random(23)
+    xs = [rng.randrange(R) for _ in range(l)]
+    shares = pp.pack_from_public_rand(
+        F.encode([xs]), np.random.default_rng(42)
+    )
+    back = F.decode(pp.unpack(shares))[0]
+    assert [int(v) for v in back] == xs
+    # randomized packing differs from deterministic packing
+    det = F.decode(pp.pack_from_public(F.encode([xs])))[0]
+    assert [int(v) for v in F.decode(shares)[0]] != [int(v) for v in det]
+
+
+def test_unpack_host_matches_device_unpack_of_host_shares():
+    l = 4
+    pp = PackedSharingParams(l)
+    rng = random.Random(31)
+    xs = [rng.randrange(R) for _ in range(l)]
+    shares = pack_host(pp, xs)
+    assert unpack_host(pp, shares) == xs
+
+
+def test_packexp_unpackexp_group_elements():
+    """Pack G1 points 'in the exponent' and unpack them back
+    (dmsm/mod.rs packexp_from_public/unpackexp semantics)."""
+    l = 2
+    pp = PackedSharingParams(l)
+    C = g1()
+    rng = random.Random(77)
+    ks = [rng.randrange(1, R) for _ in range(l)]
+    pts = [rm.G1.scalar_mul(G1_GENERATOR, k) for k in ks]
+    packed = pp.packexp_from_public(C, C.encode(pts))
+    assert packed.shape == (pp.n, 3, 16)
+    # shares in the exponent match host-side scalar relation:
+    # packed[p] = sum_i M[p][i] * pts[i]  <=>  g^(pack of exponents)
+    exp_shares = pack_host(pp, ks)
+    expect = [rm.G1.scalar_mul(G1_GENERATOR, e) for e in exp_shares]
+    assert C.decode(packed) == expect
+    back = pp.unpackexp(C, packed)
+    assert C.decode(back) == pts
+
+
+def test_unpackexp_degree2():
+    """unpackexp(degree2=True) inverts packing on the secret2 layout: a
+    product of two degree-(t+l) sharings unpacks in the exponent."""
+    l = 2
+    pp = PackedSharingParams(l)
+    C = g1()
+    rng = random.Random(88)
+    xs = [rng.randrange(R) for _ in range(l)]
+    ys = [rng.randrange(R) for _ in range(l)]
+    hx, hy = pack_host(pp, xs), pack_host(pp, ys)
+    prod_shares = [a * b % R for a, b in zip(hx, hy)]
+    pts = [rm.G1.scalar_mul(G1_GENERATOR, e) for e in prod_shares]
+    back = pp.unpackexp(C, C.encode(pts), degree2=True)
+    expect = [
+        rm.G1.scalar_mul(G1_GENERATOR, x * y % R) for x, y in zip(xs, ys)
+    ]
+    assert C.decode(back) == expect
