@@ -1,0 +1,22 @@
+"""King-mediated degree reduction (dist-primitives/src/utils/deg_red.rs:10-28):
+gather degree-2(t+l) shares, unpack2 + re-pack every chunk (one batched
+tiny-NTT kernel on the king), scatter fresh degree-(t+l) shares."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .net import Net
+from .pss import PackedSharingParams
+
+
+async def deg_red(px, pp: PackedSharingParams, net: Net, sid: int = 0):
+    """px: (c, 16) per-party share vector -> (c, 16) reduced-degree shares."""
+
+    def king(vals):
+        x = jnp.swapaxes(jnp.stack(vals, axis=0), 0, 1)  # (c, n, 16)
+        out = pp.pack_from_public(pp.unpack2(x))  # (c, n, 16)
+        per_party = jnp.swapaxes(out, 0, 1)
+        return [per_party[i] for i in range(pp.n)]
+
+    return await net.king_compute(px, king, sid)

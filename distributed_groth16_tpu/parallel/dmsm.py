@@ -1,0 +1,44 @@
+"""Distributed MSM over packed shares — hot kernel #2.
+
+d_msm (dist-primitives/src/dmsm/mod.rs:70-98): every party runs one local
+Pippenger MSM over its m/l packed-share (bases, scalars) — the dominant
+compute, on-device via ops/msm.py — producing one group element whose
+sharing polynomial has degree 2(t+l). The king gathers the n points,
+unpacks them in the exponent (degree2), sums the l recovered partial MSMs
+and broadcasts the final value.
+
+Communication: O(1) group elements per party — d_msm is compute-bound.
+"""
+
+from __future__ import annotations
+
+from ..ops.curve import CurvePoints
+from ..ops.field import fr
+from ..ops.msm import msm
+from .net import Net
+from .pss import PackedSharingParams
+
+
+async def d_msm(
+    curve: CurvePoints,
+    bases,
+    scalar_shares,
+    pp: PackedSharingParams,
+    net: Net,
+    sid: int = 0,
+):
+    """bases: (c, 3) + elem packed-in-the-exponent CRS shares;
+    scalar_shares: (c, 16) Montgomery-form packed witness shares.
+    Returns the clear MSM result (3,) + elem on every party."""
+    F = fr()
+    local = msm(curve, bases, F.from_mont(scalar_shares))
+
+    def king(points):
+        import jax.numpy as jnp
+
+        stacked = jnp.stack(points, axis=0)  # (n, 3) + elem
+        partials = pp.unpackexp(curve, stacked, degree2=True)  # (l, 3) + elem
+        total = curve.sum(partials, axis=0)
+        return [total] * pp.n
+
+    return await net.king_compute(local, king, sid)

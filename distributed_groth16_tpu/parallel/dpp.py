@@ -1,0 +1,40 @@
+"""Distributed partial (prefix) products (dist-primitives/src/dpp/mod.rs:17-88):
+given packed shares of num and den, returns packed shares of
+num[0]/den[0], (num[0]num[1])/(den[0]den[1]), ...
+
+Protocol: mask with preprocessed randomness s (dummy s = 1 today, as in the
+reference, dpp/mod.rs:24-26), gather num||den to the king, king unpack2s,
+divides, computes the prefix products in the clear (a batched
+`lax.associative_scan` under Montgomery mul — log-depth instead of the
+reference's sequential loop), re-packs consecutively, scatters; parties
+strip s and run deg_red."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.field import fr
+from .degred import deg_red
+from .net import Net
+from .pss import PackedSharingParams
+
+
+async def d_pp(num, den, pp: PackedSharingParams, net: Net, sid: int = 0):
+    """num, den: (c, 16) per-party packed share vectors."""
+    F = fr()
+    numden = jnp.concatenate([num, den], axis=0)  # (2c, 16)
+
+    def king(vals):
+        x = jnp.swapaxes(jnp.stack(vals, axis=0), 0, 1)  # (2c, n, 16)
+        secrets = pp.unpack2(x).reshape(-1, 16)  # (2c*l, 16) chunk-major
+        half = secrets.shape[0] // 2
+        nums, dens = secrets[:half], secrets[half:]
+        ratio = F.mul(nums, F.inv(dens))
+        prefix = jax.lax.associative_scan(F.mul, ratio, axis=0)
+        out = pp.pack_from_public(prefix.reshape(-1, pp.l, 16))  # (c, n, 16)
+        per_party = jnp.swapaxes(out, 0, 1)
+        return [per_party[i] for i in range(pp.n)]
+
+    masked = await net.king_compute(numden, king, sid)
+    return await deg_red(masked, pp, net, sid)

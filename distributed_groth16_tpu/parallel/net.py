@@ -1,0 +1,183 @@
+"""Transport-agnostic star-topology collectives ("the NCCL layer").
+
+Re-imagines the reference's mpc-net crate (mpc-net/src/lib.rs:37-155) for the
+TPU build. The collective vocabulary is exactly the reference's three
+primitives plus point-to-point sends:
+
+  * gather_to_king    — client_send_or_king_receive (lib.rs:61-99): every
+                        party contributes one value; the king gets the full
+                        list ordered by party id (own value included), clients
+                        get None.
+  * scatter_from_king — client_receive_or_king_send (lib.rs:102-139): king
+                        provides one value per party (keeps its own), clients
+                        receive theirs.
+  * king_compute      — fused gather -> f on king -> scatter (lib.rs:146-155).
+
+Three logical channels (CHANNELS = 3, mirroring MultiplexedStreamID::
+{Zero,One,Two}, lib.rs:28-33) let three independent collectives overlap —
+the a/b/c FFT pipelines and the W/U/H MSMs of the prover.
+
+Unlike the reference, values are arbitrary Python objects (typically JAX
+arrays or pytrees of them): the typed-serialization layer (dist-primitives'
+MpcSerNet) is only needed at a real process boundary and lives with the
+gRPC/TLS transport; in-process backends hand device buffers over directly —
+zero-copy, no host round-trip.
+
+Backends:
+  * LocalSimNet — n asyncio tasks + in-memory queues, the LocalTestNet /
+    ChannelIO analog (mpc-net/src/multi.rs:227, prod.rs:409-491) used by all
+    distributed tests. Harness: `simulate_network_round` (multi.rs:289-316).
+  * the sharded single-program mesh backend lives in parallel/mesh.py: inside
+    one jitted program parties are mesh shards and these collectives become
+    XLA all_gather/ppermute over ICI.
+  * a TLS star over DCN for true multi-host MPC lives in parallel/prodnet.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Protocol, Sequence
+
+CHANNELS = 3
+
+
+class MpcNetError(RuntimeError):
+    pass
+
+
+class Net(Protocol):
+    """The MpcNet-shaped async interface every distributed kernel takes."""
+
+    party_id: int
+    n_parties: int
+
+    @property
+    def is_king(self) -> bool: ...
+
+    async def send_to(self, to: int, value: Any, sid: int = 0) -> None: ...
+
+    async def recv_from(self, frm: int, sid: int = 0) -> Any: ...
+
+    async def gather_to_king(self, value: Any, sid: int = 0): ...
+
+    async def scatter_from_king(self, values, sid: int = 0): ...
+
+
+class BaseNet:
+    """Collectives implemented over send_to/recv_from (as in the reference,
+    where they are trait default methods)."""
+
+    party_id: int
+    n_parties: int
+
+    @property
+    def is_king(self) -> bool:
+        return self.party_id == 0
+
+    async def send_to(self, to: int, value: Any, sid: int = 0) -> None:
+        raise NotImplementedError
+
+    async def recv_from(self, frm: int, sid: int = 0) -> Any:
+        raise NotImplementedError
+
+    async def gather_to_king(self, value: Any, sid: int = 0):
+        """King returns [v_0, ..., v_{n-1}] (own value at index 0);
+        clients send and return None."""
+        if self.is_king:
+            out = [value]
+            recvs = [
+                self.recv_from(i, sid) for i in range(1, self.n_parties)
+            ]
+            out.extend(await asyncio.gather(*recvs))
+            return out
+        await self.send_to(0, value, sid)
+        return None
+
+    async def scatter_from_king(self, values, sid: int = 0):
+        """King passes one value per party (or None if client); every party
+        returns its own value."""
+        if self.is_king:
+            if values is None:
+                raise MpcNetError("scatter_from_king: king must provide values")
+            if len(values) != self.n_parties:
+                raise MpcNetError(
+                    f"scatter_from_king: {len(values)} values for "
+                    f"{self.n_parties} parties"
+                )
+            sends = [
+                self.send_to(i, values[i], sid)
+                for i in range(1, self.n_parties)
+            ]
+            await asyncio.gather(*sends)
+            return values[0]
+        if values is not None:
+            raise MpcNetError("scatter_from_king: client must pass None")
+        return await self.recv_from(0, sid)
+
+    async def king_compute(
+        self,
+        value: Any,
+        f: Callable[[list], list],
+        sid: int = 0,
+    ):
+        """gather -> f on king -> scatter (MpcNet::king_compute)."""
+        gathered = await self.gather_to_king(value, sid)
+        out = f(gathered) if gathered is not None else None
+        return await self.scatter_from_king(out, sid)
+
+    async def broadcast_from_king(self, value: Any, sid: int = 0):
+        """King's value to everyone (the d_msm result fan-out,
+        dmsm/mod.rs:94-97)."""
+        vals = [value] * self.n_parties if self.is_king else None
+        return await self.scatter_from_king(vals, sid)
+
+
+class LocalSimNet(BaseNet):
+    """In-process n-party network: one shared mailbox fabric, one instance
+    per party. The LocalTestNet role (multi.rs:227-316) without sockets."""
+
+    def __init__(self, party_id: int, n_parties: int, fabric):
+        self.party_id = party_id
+        self.n_parties = n_parties
+        self._fabric = fabric
+
+    async def send_to(self, to: int, value: Any, sid: int = 0) -> None:
+        if not (0 <= to < self.n_parties) or to == self.party_id:
+            raise MpcNetError(f"bad destination {to}")
+        await self._fabric[(self.party_id, to, sid)].put(value)
+
+    async def recv_from(self, frm: int, sid: int = 0) -> Any:
+        if not (0 <= frm < self.n_parties) or frm == self.party_id:
+            raise MpcNetError(f"bad source {frm}")
+        return await self._fabric[(frm, self.party_id, sid)].get()
+
+
+def make_local_nets(n_parties: int) -> list[LocalSimNet]:
+    """One LocalSimNet per party over a fresh shared fabric."""
+    fabric = {
+        (s, d, c): asyncio.Queue()
+        for s in range(n_parties)
+        for d in range(n_parties)
+        for c in range(CHANNELS)
+        if s != d
+    }
+    return [LocalSimNet(i, n_parties, fabric) for i in range(n_parties)]
+
+
+def simulate_network_round(
+    n_parties: int,
+    closure: Callable[[Net, Any], Awaitable[Any]],
+    per_party_data: Sequence[Any] | None = None,
+) -> list:
+    """Run `closure(net, data)` concurrently for every party; return results
+    ordered by party id (mpc-net/src/multi.rs:289-316 harness)."""
+
+    async def _run():
+        nets = make_local_nets(n_parties)
+        tasks = [
+            closure(nets[i], per_party_data[i] if per_party_data else None)
+            for i in range(n_parties)
+        ]
+        return await asyncio.gather(*tasks)
+
+    return asyncio.run(_run())

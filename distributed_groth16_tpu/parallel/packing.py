@@ -1,0 +1,54 @@
+"""Share-vector layout helpers: the n x (m/l) <-> (m/l) x n reshapes and the
+two chunking conventions of the reference (dist-primitives/src/utils/pack.rs
+pack_vec/transpose; strided layout per groth16/src/qap.rs:143-187 and
+dist-primitives/examples/local_dfft_test.rs).
+
+Layouts over a clear vector s of length m (l secrets per share, c = m/l
+chunks):
+
+  * consecutive ("pack_vec"): chunk i = s[i*l .. (i+1)*l]
+  * strided + bit-reversed ("qap/dfft layout"): first bit-reverse s, then
+    chunk i = s_rev[i], s_rev[i+c], s_rev[i+2c], ...
+
+Both pack each chunk with PSS and transpose to per-party share vectors of
+shape (n, c, 16). Everything is batched device code.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.ntt import bitrev_perm
+from .pss import PackedSharingParams
+
+
+def pack_consecutive(pp: PackedSharingParams, vec: jnp.ndarray) -> jnp.ndarray:
+    """(m, 16) clear vector -> (n, m/l, 16) per-party shares, consecutive
+    chunking (pack_vec + transpose)."""
+    m = vec.shape[0]
+    assert m % pp.l == 0
+    chunks = vec.reshape(m // pp.l, pp.l, 16)
+    shares = pp.pack_from_public(chunks)  # (c, n, 16)
+    return jnp.swapaxes(shares, 0, 1)
+
+
+def pack_strided(pp: PackedSharingParams, vec: jnp.ndarray) -> jnp.ndarray:
+    """(m, 16) clear vector -> (n, m/l, 16) per-party shares in the
+    bit-reversed strided layout every d_fft/d_ifft input uses."""
+    m = vec.shape[0]
+    assert m % pp.l == 0
+    c = m // pp.l
+    x = jnp.take(vec, jnp.asarray(bitrev_perm(m)), axis=0)
+    chunks = jnp.swapaxes(x.reshape(pp.l, c, 16), 0, 1)  # chunk i slot j = x[i + j*c]
+    shares = pp.pack_from_public(chunks)  # (c, n, 16)
+    return jnp.swapaxes(shares, 0, 1)
+
+
+def unpack_shares(
+    pp: PackedSharingParams, shares: jnp.ndarray, degree2: bool = False
+) -> jnp.ndarray:
+    """(n, c, 16) per-party shares -> (c*l, 16) clear vector in chunk-major
+    order (element i*l + j = secret j of chunk i)."""
+    chunks = jnp.swapaxes(shares, 0, 1)  # (c, n, 16)
+    secrets = pp.unpack2(chunks) if degree2 else pp.unpack(chunks)  # (c, l, 16)
+    return secrets.reshape(-1, 16)

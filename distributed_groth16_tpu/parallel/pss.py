@@ -27,12 +27,14 @@ from __future__ import annotations
 
 import functools
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops import refmath as rm
 from ..ops.constants import FR_GENERATOR, R
 from ..ops.curve import CurvePoints, scalar_bits
+from ..ops.field import fr
 from ..ops.msm import encode_scalars_std
 from ..ops.ntt import domain
 
@@ -62,14 +64,16 @@ class PackedSharingParams:
         return self.share.fft(self.secret.ifft(secrets))
 
     def pack_from_public_rand(self, secrets, rng: np.random.Generator):
-        """Packing with t+1 random filler points (pss.rs:72-82 semantics;
-        the reference uses a test rng — randomness only has to be dropped by
-        unpack, which truncates to l)."""
+        """Packing with t+1 uniform-in-Fr random filler points — the hiding
+        randomness of the PSS scheme (pss.rs:72-82; unlike the reference's
+        test rng, fillers here are drawn uniformly from the full field)."""
         assert secrets.shape[-2] == self.l
-        fr = _fr()
-        rand = fr.encode(
-            rng.integers(0, 2**63, size=secrets.shape[:-2] + (self.t + 1,))
-        )
+        batch = secrets.shape[:-2]
+        count = int(np.prod(batch, dtype=np.int64)) * (self.t + 1)
+        vals = np.empty(count, dtype=object)
+        for i in range(count):
+            vals[i] = int.from_bytes(rng.bytes(40), "little") % R
+        rand = fr().encode(vals.reshape(batch + (self.t + 1,)))
         full = jnp.concatenate([secrets, rand], axis=-2)
         return self.share.fft(self.secret.ifft(full))
 
@@ -124,36 +128,60 @@ class PackedSharingParams:
 
     # -- group-element ("in the exponent") transforms -------------------------
 
-    def _apply_point_matrix(self, curve: CurvePoints, mat, pts):
-        """out[..., o, :] = sum_i mat[o][i] * pts[..., i, :].
-
-        pts: (..., k) + point shape; mat: (o, k) ints. One 256-step
-        double-and-add ladder batched over (..., o, k), then a log-k tree sum.
-        """
+    @staticmethod
+    def _matrix_bits(mat) -> jnp.ndarray:
+        """(o, k) int matrix -> (o, k, 256) bit tensor, cached per matrix."""
         o, k = len(mat), len(mat[0])
         flat = [mat[a][b] for a in range(o) for b in range(k)]
-        bits = scalar_bits(encode_scalars_std(flat)).reshape(o, k, 256)
+        return scalar_bits(encode_scalars_std(flat)).reshape(o, k, 256)
+
+    @functools.cached_property
+    def pack_matrix_bits(self):
+        return self._matrix_bits(self.pack_matrix)
+
+    @functools.cached_property
+    def unpack_matrix_bits(self):
+        return self._matrix_bits(self.unpack_matrix)
+
+    @functools.cached_property
+    def unpack2_matrix_bits(self):
+        return self._matrix_bits(self.unpack2_matrix)
+
+    def _apply_point_matrix(self, curve: CurvePoints, bits, pts):
+        """out[..., o, :] = sum_i mat[o][i] * pts[..., i, :].
+
+        pts: (..., k) + point shape; bits: (o, k, 256) matrix bit tensor.
+        One 256-step ladder: the doubling chain runs on the (..., k) points
+        only (it is row-independent); the conditional adds run batched over
+        (..., o, k). Then a log-k tree sum over the k axis.
+        """
+        o, k = bits.shape[0], bits.shape[1]
         ax = pts.ndim - 2 - curve.coord_axes  # index of the k axis
         batch = pts.shape[:ax]
-        p = jnp.expand_dims(pts, ax)  # (..., 1, k) + point
-        terms = curve.scalar_mul_bits(p, bits)  # (..., o, k) + point
-        return curve.sum(terms, axis=len(batch) + 1)
+        acc = jnp.broadcast_to(
+            curve.infinity(),
+            batch + (o, k, 3) + curve.elem_shape,
+        )
+        base = pts
+
+        def body(i, state):
+            acc, base = state
+            bit = bits[..., i]  # (o, k)
+            cand = curve.add(acc, jnp.expand_dims(base, ax))
+            acc = curve.select(bit == 1, cand, acc)
+            return acc, curve.double(base)
+
+        acc, _ = jax.lax.fori_loop(0, 256, body, (acc, base))
+        return curve.sum(acc, axis=len(batch) + 1)
 
     def packexp_from_public(self, curve: CurvePoints, pts):
         """(..., l) + point -> (..., n) + point (dmsm/mod.rs:61-68)."""
-        return self._apply_point_matrix(curve, self.pack_matrix, pts)
+        return self._apply_point_matrix(curve, self.pack_matrix_bits, pts)
 
     def unpackexp(self, curve: CurvePoints, shares, degree2: bool = False):
         """(..., n) + point -> (..., l) + point (dmsm/mod.rs:7-48)."""
-        mat = self.unpack2_matrix if degree2 else self.unpack_matrix
-        return self._apply_point_matrix(curve, mat, shares)
-
-
-@functools.cache
-def _fr():
-    from ..ops.field import fr
-
-    return fr()
+        bits = self.unpack2_matrix_bits if degree2 else self.unpack_matrix_bits
+        return self._apply_point_matrix(curve, bits, shares)
 
 
 @functools.cache

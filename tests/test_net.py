@@ -1,0 +1,89 @@
+"""Net-layer tests: the sum-of-ids smoke test (mpc-net/examples/add_ids.rs)
+plus collective semantics and channel independence."""
+
+import asyncio
+
+import pytest
+
+from distributed_groth16_tpu.parallel.net import (
+    CHANNELS,
+    MpcNetError,
+    make_local_nets,
+    simulate_network_round,
+)
+
+
+def test_sum_of_ids():
+    """Every party contributes its id; king sums and broadcasts — the
+    reference's prod smoke test (add_ids.rs)."""
+
+    async def party(net, _):
+        def f(vals):
+            return [sum(vals)] * net.n_parties
+
+        return await net.king_compute(net.party_id, f)
+
+    out = simulate_network_round(8, party)
+    assert out == [sum(range(8))] * 8
+
+
+def test_gather_ordering_and_king_inclusion():
+    async def party(net, data):
+        got = await net.gather_to_king(data)
+        if net.is_king:
+            assert got == [f"p{i}" for i in range(net.n_parties)]
+            return "king-saw-all"
+        assert got is None
+        return "client"
+
+    out = simulate_network_round(
+        4, party, [f"p{i}" for i in range(4)]
+    )
+    assert out[0] == "king-saw-all"
+
+
+def test_scatter_from_king():
+    async def party(net, _):
+        vals = [i * 10 for i in range(net.n_parties)] if net.is_king else None
+        return await net.scatter_from_king(vals)
+
+    assert simulate_network_round(4, party) == [0, 10, 20, 30]
+
+
+def test_scatter_validates_length():
+    async def party(net, _):
+        if net.is_king:
+            with pytest.raises(MpcNetError):
+                await net.scatter_from_king([1, 2])  # wrong length
+            # then run a correct scatter so clients unblock
+            return await net.scatter_from_king(list(range(net.n_parties)))
+        return await net.scatter_from_king(None)
+
+    assert simulate_network_round(3, party) == [0, 1, 2]
+
+
+def test_channels_are_independent():
+    """Two concurrent collectives on different sids don't interleave."""
+
+    async def party(net, _):
+        async def round_on(sid, tag):
+            def f(vals):
+                assert all(v[0] == tag for v in vals)
+                return [(tag, sum(v[1] for v in vals))] * net.n_parties
+
+            return await net.king_compute((tag, net.party_id), f, sid=sid)
+
+        a, b = await asyncio.gather(
+            round_on(0, "a"), round_on(2, "b")
+        )
+        return a, b
+
+    out = simulate_network_round(4, party)
+    assert all(o == (("a", 6), ("b", 6)) for o in out)
+
+
+def test_fabric_shape():
+    nets = make_local_nets(3)
+    assert [n.party_id for n in nets] == [0, 1, 2]
+    assert nets[0].is_king and not nets[1].is_king
+    assert len(nets[0]._fabric) == 3 * 2 * CHANNELS
