@@ -29,6 +29,7 @@ from .refmath import finv
 
 def bitrev_perm(n: int) -> np.ndarray:
     """Bit-reversal permutation indices (matches dfft/mod.rs:258-271)."""
+    assert n > 0 and n & (n - 1) == 0, f"bitrev needs a power of two, got {n}"
     logn = n.bit_length() - 1
     idx = np.arange(n)
     out = np.zeros(n, dtype=np.int32)

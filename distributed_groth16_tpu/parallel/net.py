@@ -27,10 +27,9 @@ Backends:
   * LocalSimNet — n asyncio tasks + in-memory queues, the LocalTestNet /
     ChannelIO analog (mpc-net/src/multi.rs:227, prod.rs:409-491) used by all
     distributed tests. Harness: `simulate_network_round` (multi.rs:289-316).
-  * the sharded single-program mesh backend lives in parallel/mesh.py: inside
-    one jitted program parties are mesh shards and these collectives become
-    XLA all_gather/ppermute over ICI.
-  * a TLS star over DCN for true multi-host MPC lives in parallel/prodnet.py.
+  * planned: a sharded single-program mesh backend (parties = mesh shards,
+    collectives = XLA all_gather/ppermute over ICI) and a TLS star over DCN
+    for true multi-host MPC.
 """
 
 from __future__ import annotations
@@ -175,7 +174,10 @@ def simulate_network_round(
     async def _run():
         nets = make_local_nets(n_parties)
         tasks = [
-            closure(nets[i], per_party_data[i] if per_party_data else None)
+            closure(
+                nets[i],
+                per_party_data[i] if per_party_data is not None else None,
+            )
             for i in range(n_parties)
         ]
         return await asyncio.gather(*tasks)

@@ -70,9 +70,17 @@ class PackedSharingParams:
         assert secrets.shape[-2] == self.l
         batch = secrets.shape[:-2]
         count = int(np.prod(batch, dtype=np.int64)) * (self.t + 1)
+        # one bulk draw of 320-bit values (>=64 bits of slack over the 254-bit
+        # modulus keeps the mod-R bias negligible), vectorized via frombuffer
+        raw = np.frombuffer(rng.bytes(count * 40), dtype=np.uint8)
+        raw = raw.reshape(count, 40)
         vals = np.empty(count, dtype=object)
-        for i in range(count):
-            vals[i] = int.from_bytes(rng.bytes(40), "little") % R
+        weights = [1 << (8 * i) for i in range(40)]
+        cols = [raw[:, i] for i in range(40)]
+        acc = np.zeros(count, dtype=object)
+        for w, col in zip(weights, cols):
+            acc += col.astype(object) * w
+        vals = acc % R
         rand = fr().encode(vals.reshape(batch + (self.t + 1,)))
         full = jnp.concatenate([secrets, rand], axis=-2)
         return self.share.fft(self.secret.ifft(full))

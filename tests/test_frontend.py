@@ -1,0 +1,74 @@
+"""Circuit frontend tests: native builder + binary readers vs the reference's
+test vectors (ark-circom/test-vectors/mycircuit.r1cs, witness.wtns)."""
+
+import os
+
+import pytest
+
+from distributed_groth16_tpu.frontend.r1cs import (
+    ConstraintSystem,
+    mult_chain_circuit,
+)
+from distributed_groth16_tpu.frontend.readers import (
+    WitnessCalculator,
+    read_r1cs,
+    read_wtns,
+)
+from distributed_groth16_tpu.ops.constants import R
+
+VECTORS = "/root/reference/ark-circom/test-vectors"
+
+
+def test_builder_mul_circuit():
+    cs = ConstraintSystem()
+    c = cs.new_instance(33)
+    a = cs.new_witness(3)
+    b = cs.new_witness(11)
+    ab = cs.mul(a, b)
+    cs.enforce([(1, ab)], [(1, cs.ONE)], [(1, c)])
+    r1cs, z = cs.finish()
+    assert r1cs.num_instance == 2
+    assert r1cs.is_satisfied(z)
+    bad = list(z)
+    bad[1] = 34
+    assert not r1cs.is_satisfied(bad)
+
+
+def test_mult_chain_circuit():
+    cs = mult_chain_circuit(7, 10)
+    r1cs, z = cs.finish()
+    assert r1cs.num_constraints == 10
+    acc = 7
+    for _ in range(10):
+        acc = (acc * acc + acc) % R
+    assert z[1] == acc
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{VECTORS}/mycircuit.r1cs"), reason="no fixture"
+)
+def test_read_r1cs_mycircuit():
+    """mycircuit.circom: private a, b; public c = a*b — one constraint."""
+    r1cs, hdr = read_r1cs(f"{VECTORS}/mycircuit.r1cs")
+    assert hdr.n_constraints == 1
+    assert hdr.n_prv_in == 2
+    assert hdr.n_pub_out == 1
+    assert r1cs.num_instance == 2  # constant 1 + public product
+    assert r1cs.num_wires == hdr.n_wires
+    # witness [1, 33, 3, 11] satisfies (a*b == c)
+    assert r1cs.is_satisfied([1, 33, 3, 11])
+    assert not r1cs.is_satisfied([1, 34, 3, 11])
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{VECTORS}/witness.wtns"), reason="no fixture"
+)
+def test_read_wtns():
+    w = read_wtns(f"{VECTORS}/witness.wtns")
+    assert w[0] == 1
+    assert all(0 <= x < R for x in w)
+
+
+def test_witness_calculator_gated():
+    with pytest.raises(NotImplementedError, match="wasmtime"):
+        WitnessCalculator("whatever.wasm")

@@ -1,0 +1,35 @@
+"""BN254 optimal-ate pairing tests (host-side verification oracle)."""
+
+from distributed_groth16_tpu.ops import pairing as pr
+from distributed_groth16_tpu.ops import refmath as rm
+from distributed_groth16_tpu.ops.constants import G1_GENERATOR, G2_GENERATOR, R
+
+E_GEN = pr.pairing(G2_GENERATOR, G1_GENERATOR)
+
+
+def test_pairing_nondegenerate_and_order_r():
+    assert E_GEN != pr.FQ12_ONE
+    assert pr.fq12_pow(E_GEN, R) == pr.FQ12_ONE
+
+
+def test_pairing_bilinear():
+    a, b = 987654321, 123456789
+    pa = rm.G1.scalar_mul(G1_GENERATOR, a)
+    qb = rm.G2.scalar_mul(G2_GENERATOR, b)
+    assert pr.pairing(qb, pa) == pr.fq12_pow(E_GEN, a * b % R)
+    # e(aP, Q) == e(P, aQ)
+    qa = rm.G2.scalar_mul(G2_GENERATOR, a)
+    assert pr.pairing(G2_GENERATOR, pa) == pr.pairing(qa, G1_GENERATOR)
+
+
+def test_pairing_infinity_is_one():
+    assert pr.pairing(None, G1_GENERATOR) == pr.FQ12_ONE
+    assert pr.pairing(G2_GENERATOR, None) == pr.FQ12_ONE
+
+
+def test_multi_pairing_cancellation():
+    a = 424242
+    pa = rm.G1.scalar_mul(G1_GENERATOR, a)
+    qb = rm.G2.scalar_mul(G2_GENERATOR, 777)
+    assert pr.pairing_check([(qb, pa), (qb, rm.G1.neg(pa))])
+    assert not pr.pairing_check([(qb, pa), (qb, pa)])
