@@ -1,0 +1,13 @@
+"""The Groth16 "model family": distributed zkSNARK proving over packed
+secret shares — the TPU-native re-design of the reference's groth16 crate."""
+
+from .keys import Proof, ProvingKey, VerifyingKey  # noqa: F401
+from .prove import (  # noqa: F401
+    distributed_prove_party,
+    pack_from_witness,
+    reassemble_proof,
+)
+from .proving_key import PackedProvingKeyShare, pack_proving_key  # noqa: F401
+from .qap import CompiledR1CS, QAP, PackedQAPShare, qap_from_r1cs  # noqa: F401
+from .setup import setup  # noqa: F401
+from .verify import verify  # noqa: F401

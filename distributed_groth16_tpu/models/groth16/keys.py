@@ -1,0 +1,162 @@
+"""Groth16 key material: device-resident proving key, host verifying key.
+
+Shapes mirror the observable arkworks ProvingKey/VerifyingKey surface the
+reference consumes (groth16/src/proving_key.rs:35-110 packs a_query,
+b_g1_query, b_g2_query, h_query, l_query; the examples reassemble with
+pk.a_query[0], pk.b_g2_query[0], vk.alpha_g1, vk.beta_g2 —
+groth16/examples/sha256.rs:208-212). Query arrays live on device as
+projective limb tensors; the verifying key is host ints because
+verification is host-side (ops/pairing.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class VerifyingKey:
+    """Host affine points: G1 = (x, y) ints, G2 = ((c0,c1),(c0,c1));
+    None = infinity."""
+
+    alpha_g1: tuple
+    beta_g2: tuple
+    gamma_g2: tuple
+    delta_g2: tuple
+    gamma_abc_g1: list  # one per instance wire (incl. the constant 1)
+
+
+@dataclass
+class ProvingKey:
+    """Device projective query arrays + the clear vk."""
+
+    vk: VerifyingKey
+    beta_g1: jnp.ndarray  # (3, 16)
+    delta_g1: jnp.ndarray  # (3, 16)
+    a_query: jnp.ndarray  # (num_wires, 3, 16)
+    b_g1_query: jnp.ndarray  # (num_wires, 3, 16)
+    b_g2_query: jnp.ndarray  # (num_wires, 3, 2, 16)
+    h_query: jnp.ndarray  # (m, 3, 16)
+    l_query: jnp.ndarray  # (num_witness, 3, 16)
+    domain_size: int
+    num_instance: int
+
+    @property
+    def num_wires(self) -> int:
+        return self.a_query.shape[0]
+
+    def save(self, path: str) -> None:
+        """Persist to one .npz (the mpc-api artifact-store format,
+        mirroring proving_key.bin/verifying_key.bin persistence at
+        mpc-api/src/main.rs:155-171)."""
+        vk = self.vk
+        meta = np.array(
+            [self.domain_size, self.num_instance], dtype=np.int64
+        )
+        np.savez_compressed(
+            path,
+            meta=meta,
+            vk=_vk_to_bytes(vk),
+            beta_g1=np.asarray(self.beta_g1),
+            delta_g1=np.asarray(self.delta_g1),
+            a_query=np.asarray(self.a_query),
+            b_g1_query=np.asarray(self.b_g1_query),
+            b_g2_query=np.asarray(self.b_g2_query),
+            h_query=np.asarray(self.h_query),
+            l_query=np.asarray(self.l_query),
+        )
+
+    @staticmethod
+    def load(path: str) -> "ProvingKey":
+        d = np.load(path)  # no pickle: key files may cross trust boundaries
+        meta = d["meta"]
+        return ProvingKey(
+            vk=_vk_from_bytes(d["vk"]),
+            beta_g1=jnp.asarray(d["beta_g1"]),
+            delta_g1=jnp.asarray(d["delta_g1"]),
+            a_query=jnp.asarray(d["a_query"]),
+            b_g1_query=jnp.asarray(d["b_g1_query"]),
+            b_g2_query=jnp.asarray(d["b_g2_query"]),
+            h_query=jnp.asarray(d["h_query"]),
+            l_query=jnp.asarray(d["l_query"]),
+            domain_size=int(meta[0]),
+            num_instance=int(meta[1]),
+        )
+
+
+# vk (de)serialization as raw 32-byte LE coordinate words — pickle-free
+# because key files may cross trust boundaries. Infinity encodes as all-zero
+# coordinates (x = y = 0 is on neither curve, both have b != 0).
+
+
+def _flatten_pt(pt) -> list[int]:
+    """G1 (x, y) -> [x, y]; G2 ((c0,c1),(c0,c1)) -> [x0, x1, y0, y1]."""
+    if pt is None:
+        return []
+    out = []
+    for coord in pt:
+        if isinstance(coord, tuple):
+            out.extend(coord)
+        else:
+            out.append(coord)
+    return out
+
+
+def _vk_to_bytes(vk: VerifyingKey) -> np.ndarray:
+    def enc(pt, nwords):
+        words = _flatten_pt(pt) or [0] * nwords
+        return b"".join(int(w).to_bytes(32, "little") for w in words)
+
+    blob = (
+        enc(vk.alpha_g1, 2)
+        + enc(vk.beta_g2, 4)
+        + enc(vk.gamma_g2, 4)
+        + enc(vk.delta_g2, 4)
+        + b"".join(enc(p, 2) for p in vk.gamma_abc_g1)
+    )
+    return np.frombuffer(blob, dtype=np.uint8)
+
+
+def _vk_from_bytes(arr: np.ndarray) -> VerifyingKey:
+    blob = arr.tobytes()
+    words = [
+        int.from_bytes(blob[32 * i : 32 * (i + 1)], "little")
+        for i in range(len(blob) // 32)
+    ]
+
+    def g1_pt(ws):
+        return None if ws == [0, 0] else (ws[0], ws[1])
+
+    def g2_pt(ws):
+        if ws == [0, 0, 0, 0]:
+            return None
+        return ((ws[0], ws[1]), (ws[2], ws[3]))
+
+    alpha = g1_pt(words[0:2])
+    beta = g2_pt(words[2:6])
+    gamma = g2_pt(words[6:10])
+    delta = g2_pt(words[10:14])
+    abc = [
+        g1_pt(words[14 + 2 * i : 16 + 2 * i])
+        for i in range((len(words) - 14) // 2)
+    ]
+    return VerifyingKey(
+        alpha_g1=alpha,
+        beta_g2=beta,
+        gamma_g2=gamma,
+        delta_g2=delta,
+        gamma_abc_g1=abc,
+    )
+
+
+@dataclass
+class Proof:
+    """Host affine proof (a: G1, b: G2, c: G1) — the wire format of the
+    service layer (common/src/dto/mod.rs)."""
+
+    a: tuple
+    b: tuple
+    c: tuple

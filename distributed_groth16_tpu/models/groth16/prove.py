@@ -1,0 +1,177 @@
+"""Distributed proof-element computation: A, B, C over packed shares.
+
+Formula parity with groth16/src/prove.rs:
+
+  A = L + r*N + dmsm_G1(S, a)          (prove.rs:10-49)
+  B = Z + s*K + dmsm_G2(V, a)          (prove.rs:51-88)
+  C = w + u + s*A + r*M + r*h  where
+      w = dmsm_G1(W, ax), u = dmsm_G1(U, h_vec), h = dmsm_G1(H, a)
+      launched concurrently on channels 0/1/2 (prove.rs:112-125)
+
+plus the witness-packing helper (sha256.rs:97-121) and the proof reassembly
+a += a_query[0] + alpha_g1, b += b_g2_query[0] + beta_g2 (sha256.rs:208-212).
+d_msm broadcasts the clear MSM value to every party, so any party's
+(A, B, C) triple is the clear proof core — the examples read result[0].
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ...ops.curve import CurvePoints, g1, g2, scalar_bits
+from ...ops.field import fr
+from ...ops.msm import encode_scalars_std
+from ...parallel.dmsm import d_msm
+from ...parallel.net import Net
+from ...parallel.packing import pack_consecutive
+from ...parallel.pss import PackedSharingParams
+from .ext_wit import h as ext_wit_h
+from .keys import Proof, ProvingKey
+from .proving_key import PackedProvingKeyShare
+from .qap import PackedQAPShare
+
+
+def _maybe_mul(curve: CurvePoints, p, k: int):
+    """k * p for a host int k; None point or k == 0 contributes infinity."""
+    if p is None or k % fr().p == 0:
+        return None
+    bits = scalar_bits(encode_scalars_std([k]))[0]
+    return curve.scalar_mul_bits(p, bits)
+
+
+def _acc(curve: CurvePoints, *pts):
+    """Sum of optional device points (None = infinity)."""
+    live = [p for p in pts if p is not None]
+    if not live:
+        return curve.infinity()
+    out = live[0]
+    for p in live[1:]:
+        out = curve.add(out, p)
+    return out
+
+
+async def compute_A(
+    pp: PackedSharingParams,
+    S: jnp.ndarray,
+    a_share: jnp.ndarray,
+    net: Net,
+    sid: int = 0,
+    L=None,
+    N=None,
+    r: int = 0,
+):
+    prod = await d_msm(g1(), S, a_share, pp, net, sid)
+    return _acc(g1(), L, _maybe_mul(g1(), N, r), prod)
+
+
+async def compute_B(
+    pp: PackedSharingParams,
+    V: jnp.ndarray,
+    a_share: jnp.ndarray,
+    net: Net,
+    sid: int = 0,
+    Z=None,
+    K=None,
+    s: int = 0,
+):
+    prod = await d_msm(g2(), V, a_share, pp, net, sid)
+    return _acc(g2(), Z, _maybe_mul(g2(), K, s), prod)
+
+
+async def compute_C(
+    pp: PackedSharingParams,
+    W: jnp.ndarray,
+    U: jnp.ndarray,
+    H: jnp.ndarray,
+    a_share: jnp.ndarray,
+    ax_share: jnp.ndarray,
+    h_share: jnp.ndarray,
+    net: Net,
+    A=None,
+    M=None,
+    r: int = 0,
+    s: int = 0,
+):
+    msms = [
+        d_msm(g1(), W, ax_share, pp, net, 0),
+        d_msm(g1(), U, h_share, pp, net, 1),
+    ]
+    # the H-query MSM only feeds the r-weighted term — skip the whole
+    # distributed round when r == 0 (the deterministic-proof path of the
+    # examples and service)
+    if r % fr().p != 0:
+        msms.append(d_msm(g1(), H, a_share, pp, net, 2))
+    results = await asyncio.gather(*msms)
+    w, u = results[0], results[1]
+    h_msm = results[2] if len(results) > 2 else None
+    return _acc(
+        g1(),
+        w,
+        u,
+        _maybe_mul(g1(), A, s),
+        _maybe_mul(g1(), M, r),
+        _maybe_mul(g1(), h_msm, r),
+    )
+
+
+def pack_from_witness(
+    pp: PackedSharingParams, values: jnp.ndarray
+) -> jnp.ndarray:
+    """(k, 16) Montgomery vector -> (n, ceil(k/l), 16) consecutive-chunk
+    shares, zero-padding the tail chunk (sha256.rs:97-121)."""
+    k = values.shape[0]
+    rem = (-k) % pp.l
+    if rem:
+        values = jnp.pad(values, [(0, rem), (0, 0)])
+    return pack_consecutive(pp, values)
+
+
+@dataclass
+class PartyProofShare:
+    a: jnp.ndarray  # (3, 16) G1 — clear values after d_msm broadcast
+    b: jnp.ndarray  # (3, 2, 16) G2
+    c: jnp.ndarray  # (3, 16) G1
+
+
+async def distributed_prove_party(
+    pp: PackedSharingParams,
+    crs_share: PackedProvingKeyShare,
+    qap_share: PackedQAPShare,
+    a_share: jnp.ndarray,
+    ax_share: jnp.ndarray,
+    net: Net,
+) -> PartyProofShare:
+    """One party's full proving round (the dsha256 template,
+    sha256.rs:26-99): h, then A, B, C."""
+    h_share = await ext_wit_h(qap_share, pp, net)
+    # A and B are independent distributed rounds — overlap them on separate
+    # channels (the reference runs them back-to-back on channel Zero)
+    pi_a, pi_b = await asyncio.gather(
+        compute_A(pp, crs_share.s, a_share, net, 0),
+        compute_B(pp, crs_share.v, a_share, net, 1),
+    )
+    pi_c = await compute_C(
+        pp,
+        crs_share.w,
+        crs_share.u,
+        crs_share.h,
+        a_share,
+        ax_share,
+        h_share,
+        net,
+    )
+    return PartyProofShare(a=pi_a, b=pi_b, c=pi_c)
+
+
+def reassemble_proof(share: PartyProofShare, pk: ProvingKey) -> Proof:
+    """Final client-side assembly (sha256.rs:208-212): add the constant-wire
+    query terms and the vk offsets, decode to host affine."""
+    C1, C2 = g1(), g2()
+    a = C1.add(share.a, C1.add(pk.a_query[0], C1.encode([pk.vk.alpha_g1])[0]))
+    b = C2.add(
+        share.b, C2.add(pk.b_g2_query[0], C2.encode([pk.vk.beta_g2])[0])
+    )
+    return Proof(a=C1.decode(a), b=C2.decode(b), c=C1.decode(share.c))

@@ -1,0 +1,64 @@
+"""CRS sharding: pack the proving key in the exponent for every party.
+
+Parity with groth16/src/proving_key.rs:19-110: per party,
+  s = pack(a_query[1..]),  u = pack(h_query),  w = pack(l_query),
+  h = pack(b_g1_query[1..]),  v = pack(b_g2_query[1..])  (G2)
+each chunked by l and packed with the in-the-exponent PSS transform
+(parallel/pss.py packexp_from_public — one batched 256-step ladder per
+query array). Tail chunks are padded with the point at infinity, which is
+sound because the matching scalar vectors are zero-padded: the per-chunk
+inner product the PSS encodes is unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ...ops.curve import CurvePoints, g1, g2
+from ...parallel.pss import PackedSharingParams
+from .keys import ProvingKey
+
+
+def _pack_query(
+    curve: CurvePoints, pp: PackedSharingParams, pts: jnp.ndarray
+) -> jnp.ndarray:
+    """(k, 3) + elem projective points -> (n, ceil(k/l), 3) + elem shares."""
+    k = pts.shape[0]
+    rem = (-k) % pp.l
+    if rem:
+        inf = jnp.broadcast_to(curve.infinity(), (rem,) + pts.shape[1:])
+        pts = jnp.concatenate([pts, inf], axis=0)
+    chunks = pts.reshape((pts.shape[0] // pp.l, pp.l) + pts.shape[1:])
+    shares = pp.packexp_from_public(curve, chunks)  # (c, n, 3) + elem
+    return jnp.swapaxes(shares, 0, 1)
+
+
+@dataclass
+class PackedProvingKeyShare:
+    """One party's CRS share (proving_key.rs:19-25)."""
+
+    s: jnp.ndarray  # (c_s, 3, 16) G1
+    u: jnp.ndarray  # (m/l, 3, 16) G1
+    v: jnp.ndarray  # (c_v, 3, 2, 16) G2
+    w: jnp.ndarray  # (c_w, 3, 16) G1
+    h: jnp.ndarray  # (c_h, 3, 16) G1
+
+
+def pack_proving_key(
+    pk: ProvingKey, pp: PackedSharingParams
+) -> list[PackedProvingKeyShare]:
+    """All-party CRS shares (proving_key.rs:35-110)."""
+    C1, C2 = g1(), g2()
+    s_all = _pack_query(C1, pp, pk.a_query[1:])
+    u_all = _pack_query(C1, pp, pk.h_query)
+    w_all = _pack_query(C1, pp, pk.l_query)
+    h_all = _pack_query(C1, pp, pk.b_g1_query[1:])
+    v_all = _pack_query(C2, pp, pk.b_g2_query[1:])
+    return [
+        PackedProvingKeyShare(
+            s=s_all[i], u=u_all[i], v=v_all[i], w=w_all[i], h=h_all[i]
+        )
+        for i in range(pp.n)
+    ]

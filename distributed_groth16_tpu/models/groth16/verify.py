@@ -1,0 +1,37 @@
+"""Groth16 verification: e(A, B) == e(alpha, beta) * e(L_pub, gamma) *
+e(C, delta), checked as one multi-pairing (host-side, ops/pairing.py).
+
+Plays the role of arkworks `verify_with_processed_vk` in the reference's
+end-to-end checks (groth16/examples/sha256.rs:228-254,
+mpc-api/src/main.rs:187-247)."""
+
+from __future__ import annotations
+
+from ...ops import refmath as rm
+from ...ops.pairing import pairing_check
+from .keys import Proof, VerifyingKey
+
+
+def prepare_inputs(vk: VerifyingKey, public_inputs: list[int]):
+    """L_pub = gamma_abc[0] + sum_i x_i * gamma_abc[i+1]."""
+    if len(public_inputs) + 1 != len(vk.gamma_abc_g1):
+        raise ValueError(
+            f"{len(public_inputs)} public inputs for "
+            f"{len(vk.gamma_abc_g1) - 1} instance wires"
+        )
+    acc = vk.gamma_abc_g1[0]
+    for x, pt in zip(public_inputs, vk.gamma_abc_g1[1:]):
+        acc = rm.G1.add(acc, rm.G1.scalar_mul(pt, x))
+    return acc
+
+
+def verify(vk: VerifyingKey, proof: Proof, public_inputs: list[int]) -> bool:
+    l_pub = prepare_inputs(vk, public_inputs)
+    return pairing_check(
+        [
+            (proof.b, proof.a),
+            (vk.beta_g2, rm.G1.neg(vk.alpha_g1)),
+            (vk.gamma_g2, rm.G1.neg(l_pub)),
+            (vk.delta_g2, rm.G1.neg(proof.c)),
+        ]
+    )

@@ -138,6 +138,7 @@ async def _d_transform(
     net: Net,
     sid: int,
     inverse: bool,
+    king_clear: bool = False,
 ):
     m = dom.size
     assert share_vec.shape[-2] * pp.l == m, (
@@ -152,10 +153,24 @@ async def _d_transform(
         share_vec = F.mul(share_vec, dom._size_inv)
     local = _fft1_local(share_vec, wpows, logm, logl, inverse)
 
-    def king(vals):
-        return _king_tail(vals, pp, logm, rearrange, pad, degree2, inverse, wpows)
-
-    return await net.king_compute(local, king, sid)
+    gathered = await net.gather_to_king(local, sid)
+    if king_clear:
+        # Fused mode: leave the clear natural-order result on the king (the
+        # caller's next step is a king-side combine — re-packing and
+        # scattering here would be immediately undone by a gather).
+        if not net.is_king:
+            return None
+        x = jnp.stack(gathered, axis=0)
+        chunks = jnp.swapaxes(x, 0, 1)
+        secrets = pp.unpack2(chunks) if degree2 else pp.unpack(chunks)
+        s1 = secrets.reshape(m, 16)
+        return _fft2_king(s1, wpows, logm, logl, inverse)
+    out = None
+    if net.is_king:
+        out = _king_tail(
+            gathered, pp, logm, rearrange, pad, degree2, inverse, wpows
+        )
+    return await net.scatter_from_king(out, sid)
 
 
 async def d_fft(
@@ -167,11 +182,17 @@ async def d_fft(
     pp: PackedSharingParams,
     net: Net,
     sid: int = 0,
+    king_clear: bool = False,
 ):
     """Packed shares of coefficients (bitrev+strided layout) -> packed shares
-    of evaluations on `dom` (d_fft, dfft/mod.rs:17-54)."""
+    of evaluations on `dom` (d_fft, dfft/mod.rs:17-54).
+
+    king_clear=True skips the re-pack + scatter and returns the clear
+    natural-order evaluations on the king (None on clients) — for callers
+    whose next step is a king-side combine (ext_wit::h)."""
     return await _d_transform(
-        pcoeff_share, rearrange, pad, degree2, dom, pp, net, sid, inverse=False
+        pcoeff_share, rearrange, pad, degree2, dom, pp, net, sid,
+        inverse=False, king_clear=king_clear,
     )
 
 
