@@ -1,0 +1,69 @@
+"""Round benchmark — prints ONE JSON line.
+
+Metric (BASELINE.json): "Groth16 prover wall-clock + MSM scalar-muls/sec
+(SHA-256 circuit, BN254)". This round's headline is the MSM kernel
+throughput on the real chip — the dominant per-party compute of the prover
+(five MSMs per proof, dist-primitives/src/dmsm/mod.rs:82): BN254 G1
+Pippenger over 2^16 points, steady-state scalar-muls/sec.
+
+vs_baseline: the reference publishes no numbers (SURVEY §6) and its Rust
+toolchain is unavailable here, so the denominator is the documented
+ballpark of arkworks' parallel CPU MSM on a modern host, ~1.0e6
+scalar-muls/sec at this size — to be replaced by a measured value when a
+side-by-side run is possible.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+N_POINTS = 1 << 16
+ARKWORKS_CPU_MSM_PER_SEC = 1.0e6  # documented ballpark, see module docstring
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_groth16_tpu.ops.curve import g1
+    from distributed_groth16_tpu.ops.msm import _msm_jit, encode_scalars_std
+    from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
+
+    rng = np.random.default_rng(0)
+    scalars = encode_scalars_std(
+        [int.from_bytes(rng.bytes(40), "little") % R for _ in range(N_POINTS)]
+    )
+    points = jnp.broadcast_to(
+        g1().encode([G1_GENERATOR])[0], (N_POINTS, 3, 16)
+    )
+
+    # compile + warm up
+    out = _msm_jit(g1(), points, scalars, 8)
+    jax.block_until_ready(out)
+
+    runs = 3
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = _msm_jit(g1(), points, scalars, 8)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / runs
+
+    muls_per_sec = N_POINTS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "msm_g1_scalar_muls_per_sec_2e16",
+                "value": round(muls_per_sec, 1),
+                "unit": "scalar-muls/sec",
+                "vs_baseline": round(
+                    muls_per_sec / ARKWORKS_CPU_MSM_PER_SEC, 4
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -49,10 +49,21 @@ async def h(
     )
 
     if net.is_king:
-        h_odd = F.sub(F.mul(p, q), w)[1::2]  # odd 2m-th roots, m entries
-        packed = pp.pack_from_public(h_odd.reshape(-1, pp.l, 16))  # (m/l,n,16)
-        per_party = jnp.swapaxes(packed, 0, 1)
+        per_party = king_combine_h(p, q, w, pp)
         out = [per_party[i] for i in range(pp.n)]
     else:
         out = None
     return await net.scatter_from_king(out, 0)
+
+
+def king_combine_h(p, q, w, pp: PackedSharingParams) -> jnp.ndarray:
+    """King-side combine: h = (p ⊙ q − w) at the ODD 2m-th roots (the
+    CircomReduction semantics — in natural domain order the odd-coset
+    entries are every second element), packed consecutively per party.
+    Inputs are clear (2m, 16) natural-order evaluation vectors; output is
+    (n, m/l, 16). Shared by the async star backend and the SPMD mesh
+    backend (parallel/mesh.py)."""
+    F = fr()
+    h_odd = F.sub(F.mul(p, q), w)[1::2]  # (m, 16)
+    packed = pp.pack_from_public(h_odd.reshape(-1, pp.l, 16))  # (m/l, n, 16)
+    return jnp.swapaxes(packed, 0, 1)

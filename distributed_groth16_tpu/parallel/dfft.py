@@ -97,8 +97,25 @@ def _fft2_king(s, wpows, logm: int, logl: int, inverse: bool):
     return jnp.roll(s, 1, axis=-2)
 
 
-def _king_tail(
-    shares_list,
+def _king_clear_array(
+    x,
+    pp: PackedSharingParams,
+    logm: int,
+    degree2: bool,
+    inverse: bool,
+    wpows,
+):
+    """Unpack a stacked (n, ..., m/l, 16) share tensor and run the stage-2
+    butterflies in the clear: the king-side head shared by the fused
+    king_clear mode of both backends. Returns (..., m, 16) natural order."""
+    chunks = jnp.moveaxis(x, 0, -2)  # (..., m/l, n, 16)
+    secrets = pp.unpack2(chunks) if degree2 else pp.unpack(chunks)
+    s1 = secrets.reshape(secrets.shape[:-3] + (1 << logm, 16))
+    return _fft2_king(s1, wpows, logm, pp.l.bit_length() - 1, inverse)
+
+
+def _king_tail_array(
+    x,
     pp: PackedSharingParams,
     logm: int,
     rearrange: bool,
@@ -107,24 +124,35 @@ def _king_tail(
     inverse: bool,
     wpows,
 ):
-    """King-side: unpack chunks, fft2, pad, (re)pack — returns per-party list."""
+    """King-side tail on a stacked (n, ..., m/l, 16) share tensor ->
+    (n, ..., c, 16) per-party output shares (pure function — shared by the
+    async star backend and the SPMD mesh backend; extra leading batch axes
+    after the party axis run as one fused transform)."""
     m = 1 << logm
-    x = jnp.stack(shares_list, axis=0)  # (n, m/l, 16)
-    chunks = jnp.swapaxes(x, 0, 1)  # (m/l, n, 16)
-    secrets = pp.unpack2(chunks) if degree2 else pp.unpack(chunks)
-    s1 = secrets.reshape(m, 16)  # chunk-major: i*l + j
-    s1 = _fft2_king(s1, wpows, logm, pp.l.bit_length() - 1, inverse)
+    s1 = _king_clear_array(x, pp, logm, degree2, inverse, wpows)
+    batch = s1.shape[:-2]
     if pad > 1:
-        s1 = jnp.pad(s1, [(0, (pad - 1) * m), (0, 0)])
+        widths = [(0, 0)] * len(batch) + [(0, (pad - 1) * m), (0, 0)]
+        s1 = jnp.pad(s1, widths)
     mp = pad * m
     c = mp // pp.l
     if rearrange:
-        s1 = jnp.take(s1, jnp.asarray(bitrev_perm(mp)), axis=0)
-        out_chunks = jnp.swapaxes(s1.reshape(pp.l, c, 16), 0, 1)
+        s1 = jnp.take(s1, jnp.asarray(bitrev_perm(mp)), axis=-2)
+        out_chunks = jnp.swapaxes(
+            s1.reshape(batch + (pp.l, c, 16)), -3, -2
+        )
     else:
-        out_chunks = s1.reshape(c, pp.l, 16)
-    out_shares = pp.pack_from_public(out_chunks)  # (c, n, 16)
-    per_party = jnp.swapaxes(out_shares, 0, 1)  # (n, c, 16)
+        out_chunks = s1.reshape(batch + (c, pp.l, 16))
+    out_shares = pp.pack_from_public(out_chunks)  # (..., c, n, 16)
+    return jnp.moveaxis(out_shares, -2, 0)  # (n, ..., c, 16)
+
+
+def _king_tail(shares_list, pp, logm, rearrange, pad, degree2, inverse, wpows):
+    """List-of-shares wrapper for the async star backend."""
+    per_party = _king_tail_array(
+        jnp.stack(shares_list, axis=0), pp, logm, rearrange, pad, degree2,
+        inverse, wpows,
+    )
     return [per_party[i] for i in range(pp.n)]
 
 
@@ -160,11 +188,9 @@ async def _d_transform(
         # scattering here would be immediately undone by a gather).
         if not net.is_king:
             return None
-        x = jnp.stack(gathered, axis=0)
-        chunks = jnp.swapaxes(x, 0, 1)
-        secrets = pp.unpack2(chunks) if degree2 else pp.unpack(chunks)
-        s1 = secrets.reshape(m, 16)
-        return _fft2_king(s1, wpows, logm, logl, inverse)
+        return _king_clear_array(
+            jnp.stack(gathered, axis=0), pp, logm, degree2, inverse, wpows
+        )
     out = None
     if net.is_king:
         out = _king_tail(

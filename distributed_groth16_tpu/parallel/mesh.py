@@ -1,0 +1,111 @@
+"""SPMD mesh backend: MPC parties as shards of a jax.sharding.Mesh.
+
+The TPU-native re-imagining of the reference's star topology for the
+intra-slice case (SURVEY §2.1 "TPU equivalent"): inside one TPU slice the
+n parties are shards along a "parties" mesh axis and the three star
+collectives become XLA collectives over ICI —
+
+  gather_to_king    -> lax.all_gather (every shard receives all shares)
+  king computes     -> every shard runs the tiny king tail REDUNDANTLY
+                       (cheaper than idling n-1 shards and avoids a
+                       scatter; identical results by determinism)
+  scatter_from_king -> each shard slices its own row by lax.axis_index
+
+The whole proving round (h-poly FFTs + the A/B/C MSMs) is ONE jitted
+shard_map program: no host round-trips, XLA overlaps the independent
+pipelines that the async star backend runs on channels 0/1/2.
+
+Privacy note: in-mesh mode all shards live in one trust domain (a single
+TPU worker), so "king sees clear values" == "the worker sees clear values",
+exactly the reference's king-node model. Cross-trust-domain deployments use
+the async star backend over real transport instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax>=0.4.35 moved shard_map out of experimental
+    from jax import shard_map as _shard_map_fn
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_fn(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_fn
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_fn(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+from ..ops.curve import g1, g2
+from ..ops.field import fr
+from ..ops.msm import msm
+from ..ops.ntt import domain
+from .dfft import _fft1_local, _king_clear_array, _king_tail_array
+from .pss import PackedSharingParams
+
+AXIS = "parties"
+
+
+def make_mesh(n_parties: int) -> Mesh:
+    devs = np.array(jax.devices()[:n_parties])
+    if len(devs) < n_parties:
+        raise RuntimeError(
+            f"need {n_parties} devices, have {len(jax.devices())}"
+        )
+    return Mesh(devs, (AXIS,))
+
+
+def _own_row(stacked):
+    """Per-shard slice of a replicated (n, ...) tensor -> (1, ...)."""
+    idx = jax.lax.axis_index(AXIS)
+    return jax.lax.dynamic_slice_in_dim(stacked, idx, 1, axis=0)
+
+
+def _mesh_dfft(
+    x,
+    pp: PackedSharingParams,
+    logm: int,
+    inverse: bool,
+    rearrange: bool,
+    pad: int,
+    degree2: bool,
+    king_clear: bool,
+    wpows,
+    size_inv,
+):
+    """x: (1, ..., m/l, 16) own share block (extra axes batch independent
+    transforms). Returns (1, ..., c, 16) shares, or the replicated clear
+    (..., m, 16) when king_clear."""
+    F = fr()
+    logl = pp.l.bit_length() - 1
+    if inverse:
+        x = F.mul(x, size_inv)
+    local = _fft1_local(x, wpows, logm, logl, inverse)
+    allg = jax.lax.all_gather(local, AXIS, axis=0, tiled=True)  # (n, ..., m/l, 16)
+    if king_clear:
+        return _king_clear_array(allg, pp, logm, degree2, inverse, wpows)
+    out = _king_tail_array(
+        allg, pp, logm, rearrange, pad, degree2, inverse, wpows
+    )
+    return _own_row(out)
+
+
+def _mesh_dmsm(curve, bases_block, scalar_block, pp: PackedSharingParams):
+    """bases: (1, c, 3)+elem, scalars: (1, c, 16) Montgomery ->
+    replicated clear (3,)+elem group element."""
+    F = fr()
+    local = msm(curve, bases_block[0], F.from_mont(scalar_block[0]))
+    allg = jax.lax.all_gather(local, AXIS, axis=0, tiled=False)  # (n,3)+elem
+    partials = pp.unpackexp(curve, allg, degree2=True)
+    return curve.sum(partials, axis=0)
