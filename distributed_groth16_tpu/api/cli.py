@@ -1,0 +1,123 @@
+"""CLI client — the zk-cli role (zk-cli/src/main.rs:30-208).
+
+Subcommands `save / prove / mpc-prove / verify` posting multipart/JSON to
+the proving service (default http://localhost:8000). The reference's
+`mpc-prove` accidentally posts to the non-MPC endpoint
+(zk-cli/src/main.rs:158-159 — copy-paste bug); here it hits
+/create_proof_with_naive_mpc as intended (SURVEY §2.13).
+
+Usage:
+  python -m distributed_groth16_tpu.api.cli save --name mul \
+      --r1cs circuit.r1cs [--wasm gen.wasm]
+  python -m distributed_groth16_tpu.api.cli prove --circuit-id ID \
+      --witness w.wtns [--out proof.bin]
+  python -m distributed_groth16_tpu.api.cli mpc-prove --circuit-id ID \
+      --witness w.wtns [--l 2]
+  python -m distributed_groth16_tpu.api.cli verify --circuit-id ID \
+      --proof proof.bin --public 33 [--public ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import requests
+
+
+def _body(resp) -> dict:
+    try:
+        body = resp.json()
+    except ValueError:
+        raise SystemExit(
+            f"server error: HTTP {resp.status_code} — {resp.text[:300]}"
+        )
+    if resp.status_code != 200:
+        raise SystemExit(f"server error: {body.get('error', body)}")
+    return body
+
+
+def _post_multipart(url: str, fields: dict) -> dict:
+    files = {k: (k, v) for k, v in fields.items()}
+    return _body(requests.post(url, files=files, timeout=3600))
+
+
+def cmd_save(args) -> dict:
+    fields = {
+        "circuit_name": args.name.encode(),
+        "r1cs_file": open(args.r1cs, "rb").read(),
+    }
+    if args.wasm:
+        fields["witness_generator"] = open(args.wasm, "rb").read()
+    return _post_multipart(f"{args.url}/save_circuit", fields)
+
+
+def _prove(args, endpoint: str) -> dict:
+    fields = {
+        "circuit_id": args.circuit_id.encode(),
+        "witness_file": open(args.witness, "rb").read(),
+    }
+    if endpoint.endswith("naive_mpc"):
+        fields["l"] = str(args.l).encode()
+    body = _post_multipart(f"{args.url}/{endpoint}", fields)
+    if args.out:
+        with open(args.out, "wb") as f:
+            f.write(bytes(body["proof"]))
+    return body
+
+
+def cmd_prove(args) -> dict:
+    return _prove(args, "create_proof_without_mpc")
+
+
+def cmd_mpc_prove(args) -> dict:
+    return _prove(args, "create_proof_with_naive_mpc")
+
+
+def cmd_verify(args) -> dict:
+    proof = list(open(args.proof, "rb").read())
+    return _body(
+        requests.post(
+            f"{args.url}/verify_proof",
+            json={
+                "circuitId": args.circuit_id,
+                "proof": proof,
+                "publicInputs": [str(x) for x in args.public],
+            },
+            timeout=600,
+        )
+    )
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="dg16-cli")
+    p.add_argument("--url", default="http://localhost:8000")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("save")
+    sp.add_argument("--name", required=True)
+    sp.add_argument("--r1cs", required=True)
+    sp.add_argument("--wasm", default=None)
+    sp.set_defaults(fn=cmd_save)
+
+    for cmd, fn in (("prove", cmd_prove), ("mpc-prove", cmd_mpc_prove)):
+        sp = sub.add_parser(cmd)
+        sp.add_argument("--circuit-id", required=True)
+        sp.add_argument("--witness", required=True, help=".wtns file")
+        sp.add_argument("--out", default=None, help="write proof bytes here")
+        sp.add_argument("--l", type=int, default=2)
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("verify")
+    sp.add_argument("--circuit-id", required=True)
+    sp.add_argument("--proof", required=True)
+    sp.add_argument("--public", action="append", default=[], type=int)
+    sp.set_defaults(fn=cmd_verify)
+
+    args = p.parse_args(argv)
+    print(json.dumps(args.fn(args), indent=2)[:2000])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,251 @@
+"""HTTP proving service — the mpc-api role (mpc-api/src/main.rs:795-805).
+
+Routes and DTO field names mirror the reference exactly:
+
+  POST /save_circuit                multipart: circuit_name, r1cs_file,
+                                    witness_generator
+  POST /create_proof_without_mpc    multipart: circuit_id, input_file |
+                                    witness_file (.wtns)
+  POST /create_proof_with_naive_mpc same fields; spins an in-process
+                                    LocalSimNet of pp.n parties inside the
+                                    handler (main.rs:560-596 — "naive" MPC)
+  POST /verify_proof                JSON: circuitId, proof (bytes),
+                                    publicInputs ([str])
+  GET  /get_circuit_files/{id}
+
+Responses use the reference's camelCase DTO shapes (common/src/dto/mod.rs):
+circuitId / circuitName / proof / isValid / timeTaken / remarks; errors are
+HTTP 500 {"error": ...} (CustomError semantics). Proofs travel as
+ark-style 128-byte compressed blobs (frontend/ark_serde.py), JSON-encoded
+as byte lists.
+
+Divergence note: witness generation from JSON `input_file` requires the
+circom WASM runtime (unavailable here — frontend/readers.py gate), so the
+witness can instead be supplied directly as a snarkjs `.wtns` upload in the
+`witness_file` field.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+import jax.numpy as jnp
+from aiohttp import web
+
+from ..frontend.ark_serde import proof_from_bytes, proof_to_bytes
+from ..frontend.readers import read_wtns
+from ..models.groth16 import (
+    CompiledR1CS,
+    distributed_prove_party,
+    pack_from_witness,
+    pack_proving_key,
+    reassemble_proof,
+    verify,
+)
+from ..models.groth16.prove import prove_single
+from ..ops.field import fr
+from ..parallel.net import simulate_network_round
+from ..parallel.pss import PackedSharingParams
+from ..utils.timers import PhaseTimings, phase
+from .store import CircuitStore
+
+MAX_BODY = 100 * 1024 * 1024  # 100 MB limit (main.rs:801)
+
+
+def _error(msg: str) -> web.Response:
+    return web.json_response({"error": msg}, status=500)
+
+
+async def _read_multipart(request) -> dict[str, bytes]:
+    reader = await request.multipart()
+    out = {}
+    async for part in reader:
+        out[part.name] = await part.read(decode=False)
+    return out
+
+
+def _millis(t0: float) -> int:
+    return int((time.time() - t0) * 1000)
+
+
+class ApiServer:
+    def __init__(self, store: CircuitStore | None = None):
+        self.store = store or CircuitStore()
+
+    # -- handlers ------------------------------------------------------------
+
+    async def save_circuit(self, request):
+        t0 = time.time()
+        try:
+            fields = await _read_multipart(request)
+            name = fields["circuit_name"].decode()
+            r1cs = fields["r1cs_file"]
+            wasm = fields.get("witness_generator", b"")
+            circuit_id = await asyncio.to_thread(
+                self.store.save_circuit, name, r1cs, wasm
+            )
+        except Exception as e:  # noqa: BLE001 — CustomError-style 500
+            return _error(str(e))
+        return web.json_response(
+            {
+                "circuitId": circuit_id,
+                "circuitName": name,
+                "timeTaken": _millis(t0),
+            }
+        )
+
+    def _witness_from_fields(self, fields, r1cs) -> list[int]:
+        if "witness_file" in fields:
+            z = read_wtns(fields["witness_file"])
+        elif "input_file" in fields:
+            raise NotImplementedError(
+                "witness generation from JSON inputs requires the circom "
+                "WASM runtime, which is unavailable; upload a snarkjs "
+                ".wtns file in the witness_file field instead"
+            )
+        else:
+            raise ValueError("need witness_file or input_file")
+        if len(z) != r1cs.num_wires or not r1cs.is_satisfied(z):
+            raise ValueError("witness does not satisfy the circuit")
+        return z
+
+    async def create_proof_without_mpc(self, request):
+        t0 = time.time()
+        try:
+            fields = await _read_multipart(request)
+            circuit_id = fields["circuit_id"].decode()
+            r1cs, pk = await asyncio.to_thread(self.store.load, circuit_id)
+            z = self._witness_from_fields(fields, r1cs)
+
+            def run():
+                comp = CompiledR1CS(r1cs)
+                return prove_single(pk, comp, fr().encode(z))
+
+            proof = await asyncio.to_thread(run)
+        except Exception as e:  # noqa: BLE001
+            return _error(str(e))
+        return web.json_response(
+            {
+                "circuitId": circuit_id,
+                "proof": list(proof_to_bytes(proof)),
+                "timeTaken": _millis(t0),
+            }
+        )
+
+    async def create_proof_with_naive_mpc(self, request):
+        t0 = time.time()
+        try:
+            fields = await _read_multipart(request)
+            circuit_id = fields["circuit_id"].decode()
+            l = int(fields.get("l", b"2").decode())
+            r1cs, pk = await asyncio.to_thread(self.store.load, circuit_id)
+            z = self._witness_from_fields(fields, r1cs)
+
+            def run():
+                timings = PhaseTimings()
+                pp = PackedSharingParams(l)
+                F = fr()
+                z_mont = F.encode(z)
+                with phase("packing", timings):
+                    comp = CompiledR1CS(r1cs)
+                    qap_shares = comp.qap(z_mont).pss(pp)
+                    crs_shares = pack_proving_key(pk, pp)
+                    ni = r1cs.num_instance
+                    a_sh = pack_from_witness(pp, z_mont[1:])
+                    ax_sh = pack_from_witness(pp, z_mont[ni:])
+
+                async def party(net, d):
+                    return await distributed_prove_party(
+                        pp, d[0], d[1], d[2], d[3], net
+                    )
+
+                with phase("MPC Proof", timings):
+                    res = simulate_network_round(
+                        pp.n,
+                        party,
+                        [
+                            (crs_shares[i], qap_shares[i], a_sh[i], ax_sh[i])
+                            for i in range(pp.n)
+                        ],
+                    )
+                return reassemble_proof(res[0], pk), timings
+
+            proof, timings = await asyncio.to_thread(run)
+        except Exception as e:  # noqa: BLE001
+            return _error(str(e))
+        return web.json_response(
+            {
+                "circuitId": circuit_id,
+                "proof": list(proof_to_bytes(proof)),
+                "timeTaken": _millis(t0),
+                "phases": timings.as_millis(),
+            }
+        )
+
+    async def verify_proof(self, request):
+        t0 = time.time()
+        try:
+            body = await request.json()
+            circuit_id = body["circuitId"]
+            proof = proof_from_bytes(bytes(body["proof"]))
+            publics = [int(x) for x in body["publicInputs"]]
+            _, pk = await asyncio.to_thread(self.store.load, circuit_id)
+            ok = await asyncio.to_thread(verify, pk.vk, proof, publics)
+        except Exception as e:  # noqa: BLE001
+            return _error(str(e))
+        return web.json_response(
+            {
+                "circuitId": circuit_id,
+                "publicInputs": [str(x) for x in publics],
+                "verifierKey": None,
+                "proof": list(body["proof"]),
+                "isValid": bool(ok),
+                "timeTaken": _millis(t0),
+                "remarks": None,
+            }
+        )
+
+    async def get_circuit_files(self, request):
+        t0 = time.time()
+        try:
+            circuit_id = request.match_info["circuit_id"]
+            r1cs, wasm = await asyncio.to_thread(
+                self.store.get_files, circuit_id
+            )
+        except Exception as e:  # noqa: BLE001
+            return _error(str(e))
+        return web.json_response(
+            {
+                "r1csFile": list(r1cs),
+                "witnessGenerator": list(wasm),
+                "timeTaken": _millis(t0),
+            }
+        )
+
+    # -- app -----------------------------------------------------------------
+
+    def app(self) -> web.Application:
+        app = web.Application(client_max_size=MAX_BODY)
+        app.router.add_post("/save_circuit", self.save_circuit)
+        app.router.add_post(
+            "/create_proof_without_mpc", self.create_proof_without_mpc
+        )
+        app.router.add_post(
+            "/create_proof_with_naive_mpc", self.create_proof_with_naive_mpc
+        )
+        app.router.add_post("/verify_proof", self.verify_proof)
+        app.router.add_get(
+            "/get_circuit_files/{circuit_id}", self.get_circuit_files
+        )
+        return app
+
+
+def main() -> None:
+    port = int(os.environ.get("PORT", "8000"))
+    web.run_app(ApiServer().app(), port=port)
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,72 @@ def read_wtns(path_or_bytes) -> list[int]:
     return [rd.field(n8) for _ in range(n_witness)]
 
 
+def write_r1cs(r1cs: R1CS, num_private_inputs: int | None = None) -> bytes:
+    """Serialize a native R1CS to the iden3 `.r1cs` binary format — lets
+    circuits built with frontend.r1cs.ConstraintSystem flow through every
+    artifact path (service store, CLI) as standard files.
+
+    num_private_inputs: the header's nPrvIn. The native ConstraintSystem
+    does not distinguish private inputs from internal wires, so this
+    defaults to num_witness (an over-count external iden3 tools will show);
+    pass the true count for spec-exact headers.
+    """
+    import io
+
+    def lc_bytes(lc):
+        out = struct.pack("<I", len(lc))
+        for coeff, wire in lc:
+            out += struct.pack("<I", wire) + int(coeff).to_bytes(32, "little")
+        return out
+
+    header = struct.pack("<I", 32) + _BN254_PRIME_LE
+    n_pub_out = 0
+    n_pub_in = r1cs.num_instance - 1
+    n_prv_in = (
+        num_private_inputs
+        if num_private_inputs is not None
+        else r1cs.num_witness
+    )
+    header += struct.pack(
+        "<IIIIQI",
+        r1cs.num_wires,
+        n_pub_out,
+        n_pub_in,
+        n_prv_in,
+        r1cs.num_wires,
+        r1cs.num_constraints,
+    )
+    constraints = b"".join(
+        lc_bytes(r1cs.a[j]) + lc_bytes(r1cs.b[j]) + lc_bytes(r1cs.c[j])
+        for j in range(r1cs.num_constraints)
+    )
+    wire_map = b"".join(
+        struct.pack("<Q", i) for i in range(r1cs.num_wires)
+    )
+    buf = io.BytesIO()
+    buf.write(b"r1cs" + struct.pack("<II", 1, 3))
+    for typ, payload in ((1, header), (2, constraints), (3, wire_map)):
+        buf.write(struct.pack("<IQ", typ, len(payload)))
+        buf.write(payload)
+    return buf.getvalue()
+
+
+def write_wtns(assignment: list[int]) -> bytes:
+    """Serialize a full assignment to the snarkjs `.wtns` binary format."""
+    import io
+
+    sec1 = struct.pack("<I", 32) + _BN254_PRIME_LE + struct.pack(
+        "<I", len(assignment)
+    )
+    sec2 = b"".join(int(v % R).to_bytes(32, "little") for v in assignment)
+    buf = io.BytesIO()
+    buf.write(b"wtns" + struct.pack("<II", 2, 2))
+    for typ, payload in ((1, sec1), (2, sec2)):
+        buf.write(struct.pack("<IQ", typ, len(payload)))
+        buf.write(payload)
+    return buf.getvalue()
+
+
 class WitnessCalculator:
     """Circom WASM witness calculator (gated on a host WASM runtime).
 

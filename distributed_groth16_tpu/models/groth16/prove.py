@@ -166,6 +166,62 @@ async def distributed_prove_party(
     return PartyProofShare(a=pi_a, b=pi_b, c=pi_c)
 
 
+def prove_single(
+    pk: ProvingKey, compiled, z_mont: jnp.ndarray, r: int = 0, s: int = 0
+) -> Proof:
+    """Single-node prove on device (r = s = 0 default) — the role the plain
+    arkworks prover plays in the reference's service
+    (mpc-api/src/main.rs:282-421) and examples (sha256.rs:158-169).
+
+    h is the CircomReduction witness map computed with device NTTs: the
+    odd-2m-th-root evaluations are one coset FFT (offset = the 2m-th root)
+    of the m-domain coefficients.
+    """
+    from ...ops.msm import msm as _msm
+    from ...ops.ntt import domain as _domain
+
+    F = fr()
+    C1, C2 = g1(), g2()
+    qap = compiled.qap(z_mont)
+    m = pk.domain_size
+    dom = _domain(m)
+    shift = _domain(2 * m).group_gen
+    dom_shift = _domain(m, offset=shift)
+    p_ev = dom_shift.fft(dom.ifft(qap.a))
+    q_ev = dom_shift.fft(dom.ifft(qap.b))
+    w_ev = dom_shift.fft(dom.ifft(qap.c))
+    h_vec = F.sub(F.mul(p_ev, q_ev), w_ev)  # (m, 16) Montgomery
+
+    z_std = F.from_mont(z_mont)
+    ni = pk.num_instance
+    a_pt = C1.add(
+        _msm(C1, pk.a_query, z_std), C1.encode([pk.vk.alpha_g1])[0]
+    )
+    b_pt = C2.add(
+        _msm(C2, pk.b_g2_query, z_std), C2.encode([pk.vk.beta_g2])[0]
+    )
+    c_pt = C1.add(
+        _msm(C1, pk.l_query, z_std[ni:]),
+        _msm(C1, pk.h_query, F.from_mont(h_vec)),
+    )
+    if r % F.p != 0:
+        a_pt = C1.add(a_pt, _maybe_mul(C1, pk.delta_g1, r))
+    if s % F.p != 0:
+        b_pt = C2.add(b_pt, _maybe_mul(C2, C2.encode([pk.vk.delta_g2])[0], s))
+    if r % F.p != 0 or s % F.p != 0:
+        # C += s*A + r*B1 - rs*delta; with B1 = beta + sum z v + s*delta the
+        # delta terms cancel, leaving s*A + r*(beta + sum z v)
+        extra = _acc(
+            C1,
+            _maybe_mul(C1, a_pt, s),
+            _maybe_mul(
+                C1, C1.add(pk.beta_g1, _msm(C1, pk.b_g1_query, z_std)), r
+            ),
+        )
+        c_pt = C1.add(c_pt, extra)
+    return Proof(a=C1.decode(a_pt), b=C2.decode(b_pt), c=C1.decode(c_pt))
+
+
 def reassemble_proof(share: PartyProofShare, pk: ProvingKey) -> Proof:
     """Final client-side assembly (sha256.rs:208-212): add the constant-wire
     query terms and the vk offsets, decode to host affine."""

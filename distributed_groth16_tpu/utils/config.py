@@ -1,0 +1,57 @@
+"""Shared CLI/config surface for distributed runs.
+
+Parity with the reference's structopt `Opt {id, input, l, t, m}`
+(dist-primitives/src/lib.rs:13-29) — the de-facto config system of every
+distributed example — plus the address-file ("hostfile") format of
+network-address/4|8: one `host:port` per rank.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass
+class Opt:
+    id: int  # party id (0 = king)
+    input: str | None  # address file path (one host:port per rank)
+    l: int = 2  # packing factor
+    t: int = 1  # corruption threshold (l - 1)
+    m: int = 32768  # domain size / vector length
+
+    @property
+    def n(self) -> int:
+        return 4 * self.l
+
+
+def parse_opt(argv=None, description: str = "distributed run") -> Opt:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--id", type=int, required=True, help="party id, 0 = king")
+    p.add_argument(
+        "--input", type=str, default=None,
+        help="address file: one host:port per rank",
+    )
+    p.add_argument("--l", type=int, default=2, help="packing factor")
+    p.add_argument("--t", type=int, default=None, help="threshold (default l-1)")
+    p.add_argument("--m", type=int, default=32768, help="domain size")
+    a = p.parse_args(argv)
+    return Opt(
+        id=a.id,
+        input=a.input,
+        l=a.l,
+        t=a.t if a.t is not None else a.l - 1,
+        m=a.m,
+    )
+
+
+def read_address_file(path: str) -> list[tuple[str, int]]:
+    """network-address/4|8 format: one host:port per line, rank order."""
+    out = []
+    for line in open(path):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        host, port = line.rsplit(":", 1)
+        out.append((host, int(port)))
+    return out

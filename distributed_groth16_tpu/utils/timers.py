@@ -1,0 +1,53 @@
+"""Phase timers — the ark_std start_timer!/end_timer! role.
+
+The reference wraps every proof phase in wall-clock scopes gated by the
+`print-trace` feature ("MSM operations", "Compute A", ... —
+groth16/examples/sha256.rs:42-91) and reports `time_taken` in API responses
+(common/src/dto/mod.rs:53-55). Here: a context manager + registry, gated by
+the DG16_TRACE env var (the RUST_LOG analog), with structured access so the
+service layer can report per-phase timings.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+log = logging.getLogger("distributed_groth16_tpu")
+
+
+def trace_enabled() -> bool:
+    return os.environ.get("DG16_TRACE", "") not in ("", "0", "false")
+
+
+@dataclass
+class PhaseTimings:
+    """Collected {phase: seconds} for one operation (e.g. one proof)."""
+
+    phases: dict[str, float] = field(default_factory=dict)
+
+    def record(self, name: str, seconds: float) -> None:
+        self.phases[name] = self.phases.get(name, 0.0) + seconds
+
+    def as_millis(self) -> dict[str, float]:
+        return {k: round(v * 1e3, 3) for k, v in self.phases.items()}
+
+
+@contextmanager
+def phase(name: str, timings: PhaseTimings | None = None):
+    """with phase("Compute A"): ... — prints when DG16_TRACE is set and
+    records into `timings` when given."""
+    t0 = time.perf_counter()
+    if trace_enabled():
+        log.info("Start: %s", name)
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if timings is not None:
+            timings.record(name, dt)
+        if trace_enabled():
+            log.info("End: %s — %.3f ms", name, dt * 1e3)
