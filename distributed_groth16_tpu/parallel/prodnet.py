@@ -1,0 +1,298 @@
+"""Production star transport: king <-> clients over (m)TLS sockets.
+
+The mpc-net ProdNet role (mpc-net/src/prod.rs:119-296), re-designed on
+asyncio:
+
+  * star topology — only king(0) <-> client connections (prod.rs:135-184);
+  * transport-generic core over an IO-stream interface
+    (new_from_pre_existing_connection genericity, prod.rs:97-117,190-243):
+    `StreamIO` wraps asyncio TCP/TLS streams, `ChannelIO` is the in-memory
+    fake used by tests (prod.rs:409-491);
+  * id handshake: a connecting client writes its u32 id (prod.rs:211);
+  * framing: u32 big-endian length prefix (the LengthDelimitedCodec
+    convention, multi.rs:26-33) around a 2-byte envelope
+    (packet_type, sid) + payload. The reference multiplexes 3 real smux
+    sub-streams; here the CHANNELS sub-streams are logical sid tags with
+    per-(peer, sid) inbound queues — same concurrency semantics (three
+    independent collectives in flight on one socket), one less protocol
+    layer;
+  * Syn/SynAck startup barrier (synchronize, prod.rs:246-296);
+  * mTLS: king requires client certs from a pinned roster store; clients
+    pin the king's cert (prod.rs:41-78). Python ssl contexts, certs from
+    utils/certs.py.
+
+Values are serialized with utils/serde.py (the MpcSerNet typed layer) —
+device arrays cross the wire as raw limb buffers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl
+import struct
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import serde
+from .net import CHANNELS, BaseNet, MpcNetError
+
+SYN, SYNACK, DATA = 0, 1, 2
+
+
+class StreamIO:
+    """asyncio stream pair (TCP or TLS) behind the minimal IO interface."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+
+    async def read_exactly(self, n: int) -> bytes:
+        return await self.reader.readexactly(n)
+
+    async def write(self, data: bytes) -> None:
+        self.writer.write(data)
+        await self.writer.drain()
+
+    async def close(self) -> None:
+        self.writer.close()
+        try:
+            await self.writer.wait_closed()
+        except Exception:  # noqa: BLE001 — peer may already be gone
+            pass
+
+
+class ChannelIO:
+    """In-memory duplex IO over asyncio.Queues — proves the core is
+    transport-generic (the reference's ChannelIO, prod.rs:409-491)."""
+
+    def __init__(self, inbox: asyncio.Queue, outbox: asyncio.Queue):
+        self._inbox = inbox
+        self._outbox = outbox
+        self._buf = b""
+
+    @staticmethod
+    def pair() -> tuple["ChannelIO", "ChannelIO"]:
+        a, b = asyncio.Queue(), asyncio.Queue()
+        return ChannelIO(a, b), ChannelIO(b, a)
+
+    async def read_exactly(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            self._buf += await self._inbox.get()
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    async def write(self, data: bytes) -> None:
+        await self._outbox.put(bytes(data))
+
+    async def close(self) -> None:
+        pass
+
+
+async def _send_frame(io, packet_type: int, sid: int, payload: bytes) -> None:
+    env = struct.pack("!IBB", len(payload) + 2, packet_type, sid)
+    await io.write(env + payload)
+
+
+async def _recv_frame(io) -> tuple[int, int, bytes]:
+    (length,) = struct.unpack("!I", await io.read_exactly(4))
+    body = await io.read_exactly(length)
+    return body[0], body[1], body[2:]
+
+
+class ProdNet(BaseNet):
+    """Star network node. Use `new_king` / `new_peer` (optionally with ssl
+    contexts from utils/certs.py for mTLS) or the `from_ios` transport-
+    generic constructors."""
+
+    def __init__(self, party_id: int, n_parties: int):
+        self.party_id = party_id
+        self.n_parties = n_parties
+        self._ios: dict[int, Any] = {}  # peer id -> IO (clients: only {0})
+        self._queues: dict[tuple[int, int], asyncio.Queue] = {}
+        self._pumps: list[asyncio.Task] = []
+        self._dead: set[int] = set()  # peers whose stream died
+        self._closed = False
+
+    # -- bring-up ------------------------------------------------------------
+
+    @classmethod
+    async def new_king(
+        cls,
+        bind: tuple[str, int],
+        n_parties: int,
+        ssl_context: ssl.SSLContext | None = None,
+    ) -> "ProdNet":
+        """Accept exactly n_parties-1 client connections, read each id
+        handshake, run the Syn/SynAck barrier (prod.rs:135-157)."""
+        self = cls(0, n_parties)
+        accepted: dict[int, StreamIO] = {}
+        done = asyncio.Event()
+
+        async def on_conn(reader, writer):
+            io = StreamIO(reader, writer)
+            (cid,) = struct.unpack("!I", await io.read_exactly(4))
+            if not (1 <= cid < n_parties) or cid in accepted:
+                await io.close()
+                return
+            accepted[cid] = io
+            if len(accepted) == n_parties - 1:
+                done.set()
+
+        server = await asyncio.start_server(
+            on_conn, bind[0], bind[1], ssl=ssl_context
+        )
+        await done.wait()
+        # stop listening; do NOT await wait_closed() — since Python 3.12 it
+        # blocks until every accepted connection closes, and ours stay open
+        server.close()
+        self._ios = dict(accepted)
+        await self._finish_setup()
+        return self
+
+    @classmethod
+    async def new_peer(
+        cls,
+        party_id: int,
+        king_addr: tuple[str, int],
+        n_parties: int,
+        ssl_context: ssl.SSLContext | None = None,
+        server_hostname: str | None = None,
+        retries: int = 50,
+    ) -> "ProdNet":
+        assert party_id != 0
+        self = cls(party_id, n_parties)
+        for attempt in range(retries):
+            try:
+                reader, writer = await asyncio.open_connection(
+                    king_addr[0],
+                    king_addr[1],
+                    ssl=ssl_context,
+                    server_hostname=server_hostname if ssl_context else None,
+                )
+                break
+            except ssl.SSLError:
+                # authentication/misconfig failures are permanent: fail fast
+                raise
+            except OSError:
+                if attempt == retries - 1:
+                    raise
+                await asyncio.sleep(0.2)
+        io = StreamIO(reader, writer)
+        await io.write(struct.pack("!I", party_id))  # id handshake
+        self._ios = {0: io}
+        await self._finish_setup()
+        return self
+
+    @classmethod
+    async def king_from_ios(
+        cls, ios: dict[int, Any], n_parties: int
+    ) -> "ProdNet":
+        self = cls(0, n_parties)
+        self._ios = dict(ios)
+        await self._finish_setup()
+        return self
+
+    @classmethod
+    async def peer_from_io(
+        cls, party_id: int, io: Any, n_parties: int
+    ) -> "ProdNet":
+        self = cls(party_id, n_parties)
+        self._ios = {0: io}
+        await self._finish_setup()
+        return self
+
+    async def _finish_setup(self) -> None:
+        for peer, io in self._ios.items():
+            for sid in range(CHANNELS):
+                self._queues[(peer, sid)] = asyncio.Queue()
+            self._pumps.append(asyncio.create_task(self._pump(peer, io)))
+        await self._synchronize()
+
+    async def _pump(self, peer: int, io) -> None:
+        """Per-connection reader: route inbound frames to (peer, sid)
+        queues so the logical channels never block each other. ANY failure
+        (EOF, malformed frame, bad sid — the peer may be hostile) marks all
+        of the peer's queues dead."""
+        try:
+            while True:
+                ptype, sid, payload = await _recv_frame(io)
+                q = self._queues.get((peer, sid))
+                if q is None:
+                    raise MpcNetError(f"bad sid {sid} from {peer}")
+                await q.put((ptype, payload))
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — death sentinel on every failure
+            self._dead.add(peer)
+            for sid in range(CHANNELS):
+                self._queues[(peer, sid)].put_nowait((None, b"Stream died"))
+
+    async def _synchronize(self) -> None:
+        """Syn/SynAck barrier (prod.rs:246-296)."""
+        if self.is_king:
+            for peer, io in self._ios.items():
+                await _send_frame(io, SYN, 0, b"")
+            for peer in self._ios:
+                ptype, _ = await self._queues[(peer, 0)].get()
+                if ptype != SYNACK:
+                    raise MpcNetError(f"no SynAck from {peer}")
+        else:
+            ptype, _ = await self._queues[(0, 0)].get()
+            if ptype != SYN:
+                raise MpcNetError("no Syn from king")
+            await _send_frame(self._ios[0], SYNACK, 0, b"")
+
+    # -- MpcNet surface ------------------------------------------------------
+
+    async def send_to(self, to: int, value: Any, sid: int = 0) -> None:
+        io = self._ios.get(to)
+        if io is None:
+            raise MpcNetError(
+                f"party {self.party_id} has no connection to {to} (star)"
+            )
+        await _send_frame(io, DATA, sid, serde.dumps(_to_wire(value)))
+
+    async def recv_from(self, frm: int, sid: int = 0) -> Any:
+        q = self._queues.get((frm, sid))
+        if q is None:
+            raise MpcNetError(
+                f"party {self.party_id} has no connection to {frm} (star)"
+            )
+        if frm in self._dead and q.empty():
+            raise MpcNetError(f"stream from {frm} died")
+        ptype, payload = await q.get()
+        if ptype != DATA:
+            # keep the queue poisoned: every later recv must also fail,
+            # not hang on an empty queue with a dead pump
+            q.put_nowait((ptype, payload))
+            raise MpcNetError(f"stream from {frm} died")
+        return _from_wire(serde.loads(payload))
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for t in self._pumps:
+            t.cancel()
+        for io in self._ios.values():
+            await io.close()
+
+
+def _to_wire(v):
+    if isinstance(v, jnp.ndarray):
+        return np.asarray(v)
+    if isinstance(v, (list, tuple)):
+        t = [_to_wire(x) for x in v]
+        return t if isinstance(v, list) else tuple(t)
+    return v
+
+
+def _from_wire(v):
+    if isinstance(v, np.ndarray):
+        return jnp.asarray(v)
+    if isinstance(v, (list, tuple)):
+        t = [_from_wire(x) for x in v]
+        return t if isinstance(v, list) else tuple(t)
+    return v

@@ -1,0 +1,75 @@
+"""Prod-net smoke test: every party contributes its id, the king sums and
+broadcasts — the mpc-net/examples/add_ids.rs protocol (208 LoC CLI runner,
+driven by scripts/prod_net_example.sh in the reference).
+
+Run one process per rank:
+  python examples/add_ids.py --id 0 --input network-address/4 \
+      --certs certs_dir --n 4
+The address file holds one host:port per rank (rank 0 = king bind addr);
+certs_dir holds <rank>.cert.pem / <rank>.key.pem for every rank (make them
+with python -m distributed_groth16_tpu.utils.certs <rank> certs_dir).
+Pass --plain to skip TLS (pure TCP star).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_groth16_tpu.parallel.prodnet import ProdNet
+from distributed_groth16_tpu.utils.certs import (
+    king_ssl_context,
+    peer_ssl_context,
+)
+from distributed_groth16_tpu.utils.config import read_address_file
+
+
+async def run(opt) -> int:
+    addrs = read_address_file(opt.input)
+    n = opt.n or len(addrs)
+    king_addr = addrs[0]
+
+    if opt.plain:
+        king_ctx = peer_ctx = None
+    else:
+        cert = lambda i: os.path.join(opt.certs, f"{i}.cert.pem")  # noqa: E731
+        key = lambda i: os.path.join(opt.certs, f"{i}.key.pem")  # noqa: E731
+        if opt.id == 0:
+            king_ctx = king_ssl_context(
+                cert(0), key(0), [cert(i) for i in range(1, n)]
+            )
+        else:
+            peer_ctx = peer_ssl_context(cert(opt.id), key(opt.id), cert(0))
+
+    if opt.id == 0:
+        net = await ProdNet.new_king(king_addr, n, None if opt.plain else king_ctx)
+    else:
+        net = await ProdNet.new_peer(
+            opt.id, king_addr, n, None if opt.plain else peer_ctx
+        )
+
+    total = await net.king_compute(
+        net.party_id, lambda ids: [sum(ids)] * n
+    )
+    await net.close()
+    expected = n * (n - 1) // 2
+    print(f"party {opt.id}: sum of ids = {total} (expected {expected})")
+    return 0 if total == expected else 1
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description="prod-net sum-of-ids smoke test")
+    p.add_argument("--id", type=int, required=True)
+    p.add_argument("--input", required=True, help="address file")
+    p.add_argument("--certs", default="certs", help="certs directory")
+    p.add_argument("--n", type=int, default=None)
+    p.add_argument("--plain", action="store_true", help="TCP without TLS")
+    return asyncio.run(run(p.parse_args()))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

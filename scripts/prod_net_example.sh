@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Prod-net integration smoke: generate per-rank certs, launch a 5-process
+# mTLS star, check the sum-of-ids result — the reference's
+# scripts/prod_net_example.sh role (.github/workflows/ci.yml:85-96).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N=5
+PORT=${PORT:-9745}
+WORK=$(mktemp -d)
+trap 'rm -rf "$WORK"' EXIT
+
+for i in $(seq 0 $((N - 1))); do
+  python -m distributed_groth16_tpu.utils.certs "$i" "$WORK/certs" >/dev/null
+done
+
+ADDR="$WORK/addresses"
+for i in $(seq 0 $((N - 1))); do
+  echo "127.0.0.1:$((PORT + i))" >> "$ADDR"
+done
+
+PIDS=()
+for i in $(seq $((N - 1)) -1 0); do
+  python examples/add_ids.py --id "$i" --input "$ADDR" --certs "$WORK/certs" --n $N &
+  PIDS+=($!)
+done
+
+STATUS=0
+for pid in "${PIDS[@]}"; do
+  wait "$pid" || STATUS=1
+done
+if [ "$STATUS" -eq 0 ]; then
+  echo "prod_net_example: OK"
+else
+  echo "prod_net_example: FAILED"
+fi
+exit $STATUS
