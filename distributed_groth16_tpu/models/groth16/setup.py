@@ -116,9 +116,12 @@ def setup(r1cs: R1CS, seed: int = 42) -> ProvingKey:
     gamma_inv = rm.finv(gamma, R)
     delta_inv = rm.finv(delta, R)
 
+    from ...utils.timers import phase
+
     m = _next_pow2(r1cs.num_constraints + r1cs.num_instance)
     ni, nw = r1cs.num_instance, r1cs.num_wires
-    u, v, w = _qap_polys_at_tau(r1cs, tau, m)
+    with phase("setup: QAP polys at tau (host)"):
+        u, v, w = _qap_polys_at_tau(r1cs, tau, m)
 
     l_query_s = [
         (beta * u[i] + alpha * v[i] + w[i]) % R * delta_inv % R
@@ -131,7 +134,9 @@ def setup(r1cs: R1CS, seed: int = 42) -> ProvingKey:
 
     # one batched G1 ladder for every G1-side scalar
     g1_scalars = u + v + l_query_s + gamma_abc_s + [alpha, beta, delta]
-    g1_pts = _g1_ladder(g1_scalars)
+    with phase("setup: G1 ladder"):
+        g1_pts = _g1_ladder(g1_scalars)
+        g1_pts.block_until_ready()
     ofs = 0
     a_query = g1_pts[ofs : ofs + nw]; ofs += nw
     b_g1_query = g1_pts[ofs : ofs + nw]; ofs += nw
@@ -141,7 +146,9 @@ def setup(r1cs: R1CS, seed: int = 42) -> ProvingKey:
         g1_pts[ofs], g1_pts[ofs + 1], g1_pts[ofs + 2]
     )
 
-    g2_pts = _g2_ladder(v + [beta, gamma, delta])
+    with phase("setup: G2 ladder"):
+        g2_pts = _g2_ladder(v + [beta, gamma, delta])
+        g2_pts.block_until_ready()
     b_g2_query = g2_pts[:nw]
     beta_g2_d, gamma_g2_d, delta_g2_d = g2_pts[nw], g2_pts[nw + 1], g2_pts[nw + 2]
 

@@ -100,7 +100,9 @@ def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
     n = points.shape[0]
     assert scalars.shape[-1] == N_LIMBS and scalars.shape[0] == n
     if window_bits is None:
-        window_bits = 8 if n >= 64 else 4
+        # the sort+scan bucketing costs ~n log n adds per window, so fewer,
+        # wider windows win once n dwarfs the 2^c bucket-combine cost
+        window_bits = 16 if n >= (1 << 14) else 8 if n >= 64 else 4
     assert LIMB_BITS % window_bits == 0, "window must divide the 16-bit limb"
     if chunk is None or chunk >= n:
         return _msm_jit(curve, points, scalars, window_bits)

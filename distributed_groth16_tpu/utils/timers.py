@@ -23,6 +23,17 @@ def trace_enabled() -> bool:
     return os.environ.get("DG16_TRACE", "") not in ("", "0", "false")
 
 
+def _emit(msg: str, *args) -> None:
+    """INFO log, falling back to stderr print when logging is unconfigured
+    (DG16_TRACE should always be visible, config or not)."""
+    if logging.getLogger().handlers or log.handlers:
+        log.info(msg, *args)
+    else:
+        import sys
+
+        print(msg % args, file=sys.stderr, flush=True)
+
+
 @dataclass
 class PhaseTimings:
     """Collected {phase: seconds} for one operation (e.g. one proof)."""
@@ -42,7 +53,7 @@ def phase(name: str, timings: PhaseTimings | None = None):
     records into `timings` when given."""
     t0 = time.perf_counter()
     if trace_enabled():
-        log.info("Start: %s", name)
+        _emit("Start: %s", name)
     try:
         yield
     finally:
@@ -50,4 +61,4 @@ def phase(name: str, timings: PhaseTimings | None = None):
         if timings is not None:
             timings.record(name, dt)
         if trace_enabled():
-            log.info("End: %s — %.3f ms", name, dt * 1e3)
+            _emit("End: %s — %.3f ms", name, dt * 1e3)

@@ -1,0 +1,84 @@
+"""Distributed + local MSM sweep — the dmsm_bench.rs / msm_bench.rs roles
+(dist-primitives/examples): d_msm over n = 4l simulated parties and the
+plain local MSM, swept over sizes 2^10..2^19 (reference loop,
+dmsm_bench.rs:42-50).
+
+Run: python examples/dmsm_bench.py [--min 10] [--max 19] [--l 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--min", type=int, default=10)
+    p.add_argument("--max", type=int, default=19)
+    p.add_argument("--l", type=int, default=2)
+    p.add_argument("--local-only", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
+    from distributed_groth16_tpu.ops.curve import g1
+    from distributed_groth16_tpu.ops.field import fr
+    from distributed_groth16_tpu.ops.msm import encode_scalars_std, msm
+    from distributed_groth16_tpu.parallel.dmsm import d_msm
+    from distributed_groth16_tpu.parallel.net import simulate_network_round
+    from distributed_groth16_tpu.parallel.packing import pack_consecutive
+    from distributed_groth16_tpu.parallel.pss import PackedSharingParams
+
+    C = g1()
+    F = fr()
+    pp = PackedSharingParams(args.l)
+    rng = np.random.default_rng(0)
+
+    for logn in range(args.min, args.max + 1):
+        n = 1 << logn
+        scalars_int = [
+            int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)
+        ]
+        points = jnp.broadcast_to(C.encode([G1_GENERATOR])[0], (n, 3, 16))
+
+        # local MSM (msm_bench.rs role)
+        std = encode_scalars_std(scalars_int)
+        out = msm(C, points, std)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = msm(C, points, std)
+        jax.block_until_ready(out)
+        t_local = time.perf_counter() - t0
+        line = f"2^{logn}: local {t_local*1e3:9.1f} ms"
+
+        if not args.local_only:
+            # distributed MSM (dmsm_bench.rs role)
+            s_shares = pack_consecutive(pp, F.encode(scalars_int))
+            base_chunks = points.reshape(n // pp.l, pp.l, 3, 16)
+            b_shares = jnp.swapaxes(
+                pp.packexp_from_public(C, base_chunks), 0, 1
+            )
+
+            async def party(net, d):
+                return await d_msm(C, d[0], d[1], pp, net)
+
+            data = [(b_shares[i], s_shares[i]) for i in range(pp.n)]
+            t0 = time.perf_counter()
+            outs = simulate_network_round(pp.n, party, data)
+            jax.block_until_ready(outs)
+            t_dist = time.perf_counter() - t0
+            line += f"   d_msm(n={pp.n}) {t_dist*1e3:9.1f} ms"
+        print(line, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

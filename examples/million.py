@@ -1,0 +1,91 @@
+"""Million-constraint chain proof — the fixtures/million workload
+(groth16/examples/million.rs: a 1M-constraint multiplicative chain,
+public input = the chain output).
+
+Run: python examples/million.py [--log2-constraints 20] [--l 2]
+At the full 2^20 scale this is a TPU workload; use a smaller
+--log2-constraints for CPU smoke runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--log2-constraints", type=int, default=20)
+    p.add_argument("--l", type=int, default=2)
+    p.add_argument("--x0", type=int, default=999992)
+    args = p.parse_args()
+
+    from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
+    from distributed_groth16_tpu.models.groth16 import (
+        CompiledR1CS,
+        distributed_prove_party,
+        pack_from_witness,
+        pack_proving_key,
+        reassemble_proof,
+        setup,
+        verify,
+    )
+    from distributed_groth16_tpu.ops.field import fr
+    from distributed_groth16_tpu.parallel.net import simulate_network_round
+    from distributed_groth16_tpu.parallel.pss import PackedSharingParams
+    from distributed_groth16_tpu.utils.timers import PhaseTimings, phase
+
+    timings = PhaseTimings()
+    nc = (1 << args.log2_constraints) - 2  # domain = 2^log2_constraints
+
+    with phase("build circuit", timings):
+        cs = mult_chain_circuit(args.x0, nc)
+        r1cs, z = cs.finish()
+    print(f"chain circuit: {r1cs.num_constraints} constraints")
+
+    with phase("setup", timings):
+        pk = setup(r1cs)
+    print(f"setup done (m = {pk.domain_size})")
+
+    F = fr()
+    z_mont = F.encode(z)
+    comp = CompiledR1CS(r1cs)
+    pp = PackedSharingParams(args.l)
+
+    with phase("packing", timings):
+        qap_shares = comp.qap(z_mont).pss(pp)
+        crs_shares = pack_proving_key(pk, pp)
+        a_sh = pack_from_witness(pp, z_mont[1:])
+        ax_sh = pack_from_witness(pp, z_mont[r1cs.num_instance:])
+
+    async def party(net, d):
+        return await distributed_prove_party(pp, d[0], d[1], d[2], d[3], net)
+
+    with phase("MPC Proof", timings):
+        res = simulate_network_round(
+            pp.n,
+            party,
+            [
+                (crs_shares[i], qap_shares[i], a_sh[i], ax_sh[i])
+                for i in range(pp.n)
+            ],
+        )
+    proof = reassemble_proof(res[0], pk)
+    ok = verify(pk.vk, proof, z[1 : r1cs.num_instance])
+    print(f"MPC proof verifies: {ok}")
+
+    print("phase timings (ms):")
+    for k, v in timings.as_millis().items():
+        print(f"  {k:30s} {v:12.1f}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    t0 = time.time()
+    code = main()
+    print(f"total {time.time() - t0:.1f}s")
+    sys.exit(code)
