@@ -16,14 +16,77 @@ side-by-side run is possible.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+import traceback
 
 N_POINTS = 1 << 16
 ARKWORKS_CPU_MSM_PER_SEC = 1.0e6  # documented ballpark, see module docstring
 
 
-def main() -> None:
+def _probe_tpu(timeout: float = 150.0) -> bool:
+    """Check in a SUBPROCESS (hang- and crash-proof) that the default jax
+    backend initializes. Round 1 lost both driver artifacts to an axon
+    backend that either hung during init (rc=124) or raised UNAVAILABLE
+    (rc=1); probing out-of-process means neither failure mode can take the
+    bench process down with it."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            timeout=timeout,
+            text=True,
+        )
+        return r.returncode == 0 and bool(r.stdout.strip())
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _init_backend():
+    """Initialize a jax backend, preferring the real TPU but never dying.
+
+    Probe the default (TPU) backend in a subprocess with retries — transient
+    UNAVAILABLE can follow a previous process holding the chip. If the probe
+    never succeeds, fall back to CPU so a number is always produced (flagged
+    via the JSON "platform" field). Returns (jax, platform_str)."""
+    ok = False
+    for attempt in range(3):
+        if _probe_tpu():
+            ok = True
+            break
+        print(
+            f"bench: TPU backend probe failed (attempt {attempt + 1}/3)",
+            file=sys.stderr,
+        )
+        if attempt < 2:
+            time.sleep(15.0 * (attempt + 1))
+    if not ok:
+        print("bench: TPU unavailable; falling back to CPU", file=sys.stderr)
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+
+    if not ok:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    return jax, jax.devices()[0].platform
+
+
+def main() -> None:
+    jax, platform = _init_backend()
+    # persistent compile cache: the first MSM compile is minutes-scale; pay
+    # it once per machine, not once per driver round
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     import jax.numpy as jnp
     import numpy as np
 
@@ -60,10 +123,26 @@ def main() -> None:
                 "vs_baseline": round(
                     muls_per_sec / ARKWORKS_CPU_MSM_PER_SEC, 4
                 ),
+                "platform": platform,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # never exit non-zero without a JSON line
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": "msm_g1_scalar_muls_per_sec_2e16",
+                    "value": 0,
+                    "unit": "scalar-muls/sec",
+                    "vs_baseline": 0,
+                    "platform": "unknown",
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            )
+        )

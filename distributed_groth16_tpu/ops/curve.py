@@ -34,7 +34,7 @@ class CurvePoints:
     has shape (..., 3) + elem_shape.
     """
 
-    def __init__(self, field, b, elem_shape):
+    def __init__(self, field, b, elem_shape, glv=None):
         self.F = field
         self.elem_shape = elem_shape
         self.coord_axes = len(elem_shape)
@@ -43,6 +43,11 @@ class CurvePoints:
         self.b3 = self._const(b3_int)  # 3*b in Montgomery form, device const
         z, o = field.consts(())
         self._zero_c, self._one_c = z, o
+        # GLV endomorphism parameters (ops/glv.py), or None when the curve
+        # has no cheap endomorphism wired up (G2): fixed-scalar ladders then
+        # fall back to full-width double-and-add.
+        self.glv = glv
+        self._beta_c = self._const(glv.beta) if glv is not None else None
         # jit the big combinational kernels once per instance
         self.add = jax.jit(self.add)
         self.double = jax.jit(self.double)
@@ -204,6 +209,12 @@ class CurvePoints:
         X, Y, Z = self._coords(p)
         return self._pack(X, self.F.neg(Y), Z)
 
+    def endo(self, p):
+        """The GLV endomorphism phi(X:Y:Z) = (beta*X : Y : Z) with
+        phi(P) = lambda*P (ops/glv.py). Only for curves with `glv` set."""
+        X, Y, Z = self._coords(p)
+        return self._pack(self.F.mul(X, self._beta_c), Y, Z)
+
     def select(self, cond, p, q):
         """where(cond, p, q) with cond of batch shape."""
         c = cond
@@ -312,12 +323,41 @@ class CurvePoints:
 
 @functools.cache
 def g1() -> CurvePoints:
-    return CurvePoints(fq(), G1_B, (N_LIMBS,))
+    from .glv import bn254_g1_glv
+
+    return CurvePoints(fq(), G1_B, (N_LIMBS,), glv=bn254_g1_glv())
 
 
 @functools.cache
 def g2() -> CurvePoints:
     return CurvePoints(fq2(), G2_B, (2, N_LIMBS))
+
+
+def fixed_scalar_ladder_tensors(curve: CurvePoints, scalars):
+    """Ladder tensors for a flat list of FIXED Fr scalars: (bits, signs, nbits).
+
+    The shared precomputation of every fixed-scalar point transform
+    (parallel/pss.py dense matrices, parallel/pointntt.py twiddles). Under
+    GLV (curve.glv set) each scalar splits into two signed ~129-bit halves
+    applied to {P, phi(P)}: bits (2, S, nbits) uint32, signs (2, S) bool,
+    part 0 = k1 on P, part 1 = k2 on phi(P). Without GLV: bits
+    (1, S, nbits=256), signs None.
+    """
+    from .constants import R as _R
+    from .msm import encode_scalars_std
+
+    s = [v % _R for v in scalars]
+    n = len(s)
+    if curve.glv is not None:
+        nbits = curve.glv.max_bits
+        halves = [curve.glv.decompose(v) for v in s]
+        flat = [abs(h[p]) for p in (0, 1) for h in halves]
+        sgn = [h[p] < 0 for p in (0, 1) for h in halves]
+        bits = scalar_bits(encode_scalars_std(flat), nbits).reshape(2, n, nbits)
+        signs = jnp.asarray(np.array(sgn, dtype=bool).reshape(2, n))
+        return bits, signs, nbits
+    bits = scalar_bits(encode_scalars_std(s), 256).reshape(1, n, 256)
+    return bits, None, 256
 
 
 def scalar_bits(scalars, nbits: int = 256) -> jnp.ndarray:

@@ -1,0 +1,203 @@
+"""Radix-2 NTT "in the exponent" — FFTs directly on curve-point tensors.
+
+The reference's group-element pack/unpack algorithm
+(dist-primitives/src/dmsm/mod.rs:7-68, delegating to ark-poly's
+Radix2EvaluationDomain FFT over a ProjectiveCurve): an IFFT on the share
+domain followed by an FFT on the secret/secret2 coset, with every butterfly
+`(lo, hi) -> (lo + w*hi, lo - w*hi)` performed on points — the twiddle
+multiplication is a fixed-scalar curve multiplication.
+
+TPU shape: each stage's lane-twiddles are FIXED Fr scalars, so a stage is
+one batched fixed-scalar ladder (GLV-halved to ~129 add rounds on G1,
+ops/glv.py) over the lanes plus one complete point addition. Total depth is
+O(nbits * log n) versus the dense matrix ladder's O(nbits) in pss.py — but
+op COUNT is O(n log n) versus O(l*n), so this path wins for large party
+counts (n >= ~64, see PackedSharingParams._NTT_THRESHOLD) and exists both
+as the scaling path and as algorithmic parity with the reference.
+
+Matches ops/ntt.py JaxDomain semantics exactly (ark Radix2EvaluationDomain:
+bit-reversal DIT, coset offsets, 1/n scaling in the inverse).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.constants import FR_GENERATOR, R
+from ..ops.curve import CurvePoints, fixed_scalar_ladder_tensors
+from ..ops.ntt import bitrev_perm
+from ..ops.refmath import finv
+
+
+def fixed_scalar_mul(curve: CurvePoints, pts, tensors):
+    """Per-lane fixed-scalar point multiplication.
+
+    pts: (..., n) + point shape; tensors from fixed_scalar_ladder_tensors
+    for the same lane count n. Returns the same shape:
+    out[..., j] = s_j * pts[..., j].
+    """
+    import jax
+
+    bits, signs, nbits = tensors
+    ax = pts.ndim - 2 - curve.coord_axes  # lane axis
+    batch = pts.shape[:ax]
+    base = jnp.expand_dims(pts, ax)  # (..., 1, n) + point
+    if curve.glv is not None:
+        base = jnp.concatenate([base, curve.endo(jnp.expand_dims(pts, ax))], axis=ax)
+    acc = jnp.broadcast_to(curve.infinity(), base.shape)
+
+    def body(i, state):
+        acc, base = state
+        bit = bits[..., i]  # (P, n)
+        addend = base
+        if signs is not None:
+            addend = curve.select(signs, curve.neg(base), base)
+        cand = curve.add(acc, addend)
+        acc = curve.select(bit == 1, cand, acc)
+        return acc, curve.double(base)
+
+    acc, _ = jax.lax.fori_loop(0, nbits, body, (acc, base))
+    # combine the GLV parts: k1*P + k2*phi(P)
+    parts = acc.shape[ax]
+    if parts == 1:
+        return jnp.squeeze(acc, axis=ax)
+    lo = jnp.take(acc, 0, axis=ax)
+    hi = jnp.take(acc, 1, axis=ax)
+    return curve.add(lo, hi)
+
+
+class PointDomain:
+    """Radix-2 evaluation domain over Fr acting on curve points."""
+
+    def __init__(self, size: int, offset: int = 1):
+        assert size > 0 and size & (size - 1) == 0
+        self.size = size
+        self.logn = size.bit_length() - 1
+        self.offset = offset % R
+        self.group_gen = pow(FR_GENERATOR, (R - 1) // size, R)
+        self._perm = jnp.asarray(bitrev_perm(size))
+
+    # host-side per-stage lane twiddles, mirroring ops/ntt.py _ntt_core
+    def _stage_scalars(self, s: int, inverse: bool) -> list[int]:
+        n = self.size
+        out = []
+        span = 1 << s
+        for j in range(n):
+            k = (j & (span - 1)) * (n >> (s + 1))
+            if inverse:
+                k = (n - k) & (n - 1)
+            out.append(pow(self.group_gen, k, R))
+        return out
+
+    def _lane_scale(self, inverse: bool) -> list[int] | None:
+        """Per-lane pre/post scaling: offset^i forward, (1/n)*offset^-i inverse."""
+        if inverse:
+            n_inv = finv(self.size, R)
+            off_inv = finv(self.offset, R) if self.offset != 1 else 1
+            return [n_inv * pow(off_inv, i, R) % R for i in range(self.size)]
+        if self.offset == 1:
+            return None
+        return [pow(self.offset, i, R) for i in range(self.size)]
+
+    def _tensors(self, curve: CurvePoints, inverse: bool):
+        # cached ON the curve object, keyed by domain content (id()-keyed
+        # caching could go stale across curve instance lifetimes)
+        cache = curve.__dict__.setdefault("_pntt_cache", {})
+        key = (self.size, self.offset, inverse)
+        if key not in cache:
+            stages = [
+                fixed_scalar_ladder_tensors(
+                    curve, self._stage_scalars(s, inverse)
+                )
+                for s in range(self.logn)
+            ]
+            scale = self._lane_scale(inverse)
+            scale_t = (
+                fixed_scalar_ladder_tensors(curve, scale)
+                if scale is not None
+                else None
+            )
+            cache[key] = (stages, scale_t)
+        return cache[key]
+
+    def _transform(self, curve: CurvePoints, pts, inverse: bool):
+        stages, scale_t = self._tensors(curve, inverse)
+        ax = pts.ndim - 2 - curve.coord_axes
+        if not inverse and scale_t is not None:
+            pts = fixed_scalar_mul(curve, pts, scale_t)
+        x = jnp.take(pts, self._perm, axis=ax)
+        n = self.size
+        j = np.arange(n)
+        for s in range(self.logn):
+            span = 1 << s
+            lo_idx = jnp.asarray(j & ~span)
+            hi_idx = jnp.asarray(j | span)
+            lo = jnp.take(x, lo_idx, axis=ax)
+            hi = jnp.take(x, hi_idx, axis=ax)
+            t = fixed_scalar_mul(curve, hi, stages[s])
+            is_lo = jnp.asarray((j & span) == 0)
+            t = curve.select(is_lo, t, curve.neg(t))
+            x = curve.add(lo, t)
+        if inverse and scale_t is not None:
+            x = fixed_scalar_mul(curve, x, scale_t)
+        return x
+
+    def fft(self, curve: CurvePoints, pts):
+        """Evaluate: (..., k<=n) coeff points -> (..., n) eval points."""
+        return self._transform(curve, _zpad_points(curve, pts, self.size), False)
+
+    def ifft(self, curve: CurvePoints, pts):
+        """Interpolate: (..., n) eval points -> (..., n) coeff points."""
+        return self._transform(curve, _zpad_points(curve, pts, self.size), True)
+
+
+def _zpad_points(curve: CurvePoints, pts, n: int):
+    ax = pts.ndim - 2 - curve.coord_axes
+    k = pts.shape[ax]
+    assert k <= n
+    if k == n:
+        return pts
+    pad_shape = pts.shape[:ax] + (n - k,)
+    inf = jnp.broadcast_to(curve.infinity(), pad_shape + (3,) + curve.elem_shape)
+    return jnp.concatenate([pts, inf], axis=ax)
+
+
+@functools.cache
+def point_domain(size: int, offset: int = 1) -> PointDomain:
+    return PointDomain(size, offset)
+
+
+# -- PSS pack/unpack in the exponent via point NTTs --------------------------
+
+
+def packexp_ntt(pp, curve: CurvePoints, pts):
+    """(..., l) + point -> (..., n) + point: secret-coset IFFT then share FFT
+    (dmsm/mod.rs:61-68)."""
+    sec = point_domain(pp.secret.size, pp.secret.offset)
+    sha = point_domain(pp.n)
+    coeffs = sec.ifft(curve, pts)
+    return sha.fft(curve, coeffs)
+
+
+def unpackexp_ntt(pp, curve: CurvePoints, shares, degree2: bool):
+    """(..., n) + point -> (..., l) + point: share IFFT then secret(2)-coset
+    FFT, truncating like the field-side unpack/unpack2 (dmsm/mod.rs:7-48)."""
+    ax = shares.ndim - 2 - curve.coord_axes
+    sha = point_domain(pp.n)
+    coeffs = sha.ifft(curve, shares)
+    if degree2:
+        sec2 = point_domain(pp.secret2.size, pp.secret2.offset)
+        evals = sec2.fft(curve, coeffs)
+        sl = [slice(None)] * evals.ndim
+        sl[ax] = slice(0, 2 * pp.l, 2)
+        return evals[tuple(sl)]
+    sec = point_domain(pp.secret.size, pp.secret.offset)
+    sl = [slice(None)] * coeffs.ndim
+    sl[ax] = slice(0, sec.size)
+    evals = sec.fft(curve, coeffs[tuple(sl)])
+    sl2 = [slice(None)] * evals.ndim
+    sl2[ax] = slice(0, pp.l)
+    return evals[tuple(sl2)]

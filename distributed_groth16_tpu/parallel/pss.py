@@ -33,9 +33,8 @@ import numpy as np
 
 from ..ops import refmath as rm
 from ..ops.constants import FR_GENERATOR, R
-from ..ops.curve import CurvePoints, scalar_bits
+from ..ops.curve import CurvePoints, fixed_scalar_ladder_tensors
 from ..ops.field import fr
-from ..ops.msm import encode_scalars_std
 from ..ops.ntt import domain
 
 
@@ -135,61 +134,116 @@ class PackedSharingParams:
         return [[cols[j][i] for j in range(self.n)] for i in range(self.l)]
 
     # -- group-element ("in the exponent") transforms -------------------------
+    #
+    # Two implementations of the same linear maps on curve points:
+    #
+    #  * dense ladder (default): the (o, k) transform matrix applied in ONE
+    #    fixed-scalar multi-exponentiation ladder. With the BN254 G1 GLV
+    #    endomorphism (ops/glv.py) every matrix entry splits into two
+    #    ~129-bit halves over the doubled base set {P, phi(P)}, so the
+    #    sequential depth is 129 point-add rounds — half of plain
+    #    double-and-add, and ~2x fewer than the reference's O(n log n)
+    #    point-domain NTT at the deployed party counts (n <= 32), where
+    #    each of the log n butterfly levels is itself a full-width ladder.
+    #
+    #  * point-domain NTT (parallel/pointntt.py): the reference's algorithm
+    #    (dist-primitives/src/dmsm/mod.rs:7-68) — IFFT on the share domain,
+    #    FFT on the secret/secret2 coset, directly on point tensors. Op
+    #    count O(n log n) beats the dense O(l n) matrix only from n ~ 64
+    #    parties up (each NTT level costs a full ladder of depth nbits), so
+    #    `method="auto"` switches there.
 
-    @staticmethod
-    def _matrix_bits(mat) -> jnp.ndarray:
-        """(o, k) int matrix -> (o, k, 256) bit tensor, cached per matrix."""
+    _NTT_THRESHOLD = 64
+
+    def _ladder_tensors(self, curve: CurvePoints, which: str):
+        """Device tensors (bits, signs, nbits) for the dense ladder of the
+        named matrix. bits: (o, K, nbits) uint32; signs: (o, K) bool (GLV
+        halves can be negative) or None; K = 2k with GLV (bases then endo
+        images), k without. Cached ON the curve object keyed by matrix
+        content (l, which) — id()-keyed caching would go stale if a curve
+        instance were collected and its id reused."""
+        cache = curve.__dict__.setdefault("_pss_ladder_cache", {})
+        key = (self.l, which)
+        if key in cache:
+            return cache[key]
+        mat = {
+            "pack": self.pack_matrix,
+            "unpack": self.unpack_matrix,
+            "unpack2": self.unpack2_matrix,
+        }[which]
         o, k = len(mat), len(mat[0])
         flat = [mat[a][b] for a in range(o) for b in range(k)]
-        return scalar_bits(encode_scalars_std(flat)).reshape(o, k, 256)
+        bits, signs, nbits = fixed_scalar_ladder_tensors(curve, flat)
+        # (P, o*k, nbits) -> per output row [part0 entries | part1 entries]
+        P = bits.shape[0]
+        bits = (
+            bits.reshape(P, o, k, nbits)
+            .transpose(1, 0, 2, 3)
+            .reshape(o, P * k, nbits)
+        )
+        if signs is not None:
+            signs = signs.reshape(P, o, k).transpose(1, 0, 2).reshape(o, P * k)
+        cache[key] = (bits, signs, nbits)
+        return cache[key]
 
-    @functools.cached_property
-    def pack_matrix_bits(self):
-        return self._matrix_bits(self.pack_matrix)
-
-    @functools.cached_property
-    def unpack_matrix_bits(self):
-        return self._matrix_bits(self.unpack_matrix)
-
-    @functools.cached_property
-    def unpack2_matrix_bits(self):
-        return self._matrix_bits(self.unpack2_matrix)
-
-    def _apply_point_matrix(self, curve: CurvePoints, bits, pts):
+    def _apply_point_matrix(self, curve: CurvePoints, which: str, pts):
         """out[..., o, :] = sum_i mat[o][i] * pts[..., i, :].
 
-        pts: (..., k) + point shape; bits: (o, k, 256) matrix bit tensor.
-        One 256-step ladder: the doubling chain runs on the (..., k) points
-        only (it is row-independent); the conditional adds run batched over
-        (..., o, k). Then a log-k tree sum over the k axis.
+        pts: (..., k) + point shape. One nbits-step ladder: the doubling
+        chain runs on the (..., K) base set only (row-independent); the
+        conditional (sign-adjusted) adds run batched over (..., o, K). Then
+        a log-K tree sum over the K axis.
         """
-        o, k = bits.shape[0], bits.shape[1]
+        bits, signs, nbits = self._ladder_tensors(curve, which)
+        o = bits.shape[0]
         ax = pts.ndim - 2 - curve.coord_axes  # index of the k axis
         batch = pts.shape[:ax]
+        base = pts
+        if curve.glv is not None:
+            base = jnp.concatenate([pts, curve.endo(pts)], axis=ax)
+        K = base.shape[ax]
         acc = jnp.broadcast_to(
             curve.infinity(),
-            batch + (o, k, 3) + curve.elem_shape,
+            batch + (o, K, 3) + curve.elem_shape,
         )
-        base = pts
 
         def body(i, state):
             acc, base = state
-            bit = bits[..., i]  # (o, k)
-            cand = curve.add(acc, jnp.expand_dims(base, ax))
+            bit = bits[..., i]  # (o, K)
+            addend = jnp.expand_dims(base, ax)
+            if signs is not None:
+                addend = curve.select(signs, curve.neg(addend), addend)
+            cand = curve.add(acc, addend)
             acc = curve.select(bit == 1, cand, acc)
             return acc, curve.double(base)
 
-        acc, _ = jax.lax.fori_loop(0, 256, body, (acc, base))
+        acc, _ = jax.lax.fori_loop(0, nbits, body, (acc, base))
         return curve.sum(acc, axis=len(batch) + 1)
 
-    def packexp_from_public(self, curve: CurvePoints, pts):
+    def packexp_from_public(self, curve: CurvePoints, pts, method="auto"):
         """(..., l) + point -> (..., n) + point (dmsm/mod.rs:61-68)."""
-        return self._apply_point_matrix(curve, self.pack_matrix_bits, pts)
+        if self._pick_exp_method(method) == "ntt":
+            from .pointntt import packexp_ntt
 
-    def unpackexp(self, curve: CurvePoints, shares, degree2: bool = False):
+            return packexp_ntt(self, curve, pts)
+        return self._apply_point_matrix(curve, "pack", pts)
+
+    def unpackexp(
+        self, curve: CurvePoints, shares, degree2: bool = False, method="auto"
+    ):
         """(..., n) + point -> (..., l) + point (dmsm/mod.rs:7-48)."""
-        bits = self.unpack2_matrix_bits if degree2 else self.unpack_matrix_bits
-        return self._apply_point_matrix(curve, bits, shares)
+        if self._pick_exp_method(method) == "ntt":
+            from .pointntt import unpackexp_ntt
+
+            return unpackexp_ntt(self, curve, shares, degree2)
+        which = "unpack2" if degree2 else "unpack"
+        return self._apply_point_matrix(curve, which, shares)
+
+    def _pick_exp_method(self, method: str) -> str:
+        if method == "auto":
+            return "ntt" if self.n >= self._NTT_THRESHOLD else "dense"
+        assert method in ("dense", "ntt")
+        return method
 
 
 @functools.cache

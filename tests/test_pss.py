@@ -109,6 +109,69 @@ def test_packexp_unpackexp_group_elements():
     assert C.decode(back) == pts
 
 
+def test_packexp_unpackexp_ntt_matches_dense():
+    """The point-domain NTT path (reference dmsm/mod.rs:7-68 algorithm)
+    computes the same maps as the dense GLV ladder."""
+    l = 2
+    pp = PackedSharingParams(l)
+    C = g1()
+    rng = random.Random(99)
+    ks = [rng.randrange(1, R) for _ in range(l)]
+    pts = [rm.G1.scalar_mul(G1_GENERATOR, k) for k in ks]
+    packed = pp.packexp_from_public(C, C.encode(pts), method="ntt")
+    exp_shares = pack_host(pp, ks)
+    expect = [rm.G1.scalar_mul(G1_GENERATOR, e) for e in exp_shares]
+    assert C.decode(packed) == expect
+    back = pp.unpackexp(C, packed, method="ntt")
+    assert C.decode(back) == pts
+    # degree2 variant
+    xs = [rng.randrange(R) for _ in range(l)]
+    ys = [rng.randrange(R) for _ in range(l)]
+    hx, hy = pack_host(pp, xs), pack_host(pp, ys)
+    prod = [a * b % R for a, b in zip(hx, hy)]
+    pts2 = [rm.G1.scalar_mul(G1_GENERATOR, e) for e in prod]
+    back2 = pp.unpackexp(C, C.encode(pts2), degree2=True, method="ntt")
+    expect2 = [
+        rm.G1.scalar_mul(G1_GENERATOR, x * y % R) for x, y in zip(xs, ys)
+    ]
+    assert C.decode(back2) == expect2
+
+
+def test_packexp_g2_no_glv():
+    """G2 has no GLV wired up: the dense ladder falls back to full-width
+    double-and-add and still packs/unpacks correctly in the exponent."""
+    from distributed_groth16_tpu.ops.constants import G2_GENERATOR
+    from distributed_groth16_tpu.ops.curve import g2
+
+    l = 2
+    pp = PackedSharingParams(l)
+    C = g2()
+    rng = random.Random(111)
+    ks = [rng.randrange(1, R) for _ in range(l)]
+    pts = [rm.G2.scalar_mul(G2_GENERATOR, k) for k in ks]
+    packed = pp.packexp_from_public(C, C.encode(pts))
+    exp_shares = pack_host(pp, ks)
+    expect = [rm.G2.scalar_mul(G2_GENERATOR, e) for e in exp_shares]
+    assert C.decode(packed) == expect
+
+
+def test_glv_decomposition():
+    from distributed_groth16_tpu.ops.glv import bn254_g1_glv
+
+    g = bn254_g1_glv()
+    rng = random.Random(7)
+    assert (g.lam * g.lam + g.lam + 1) % R == 0
+    for _ in range(50):
+        k = rng.randrange(R)
+        k1, k2 = g.decompose(k)
+        assert (k1 + k2 * g.lam - k) % R == 0
+        assert abs(k1).bit_length() <= g.max_bits
+        assert abs(k2).bit_length() <= g.max_bits
+    # endomorphism really is multiplication by lambda on the curve
+    p = rm.G1.scalar_mul(G1_GENERATOR, 12345)
+    assert rm.G1.scalar_mul(p, g.lam) == (g.beta * p[0] % rm.Q, p[1])
+
+
 def test_unpackexp_degree2():
     """unpackexp(degree2=True) inverts packing on the secret2 layout: a
     product of two degree-(t+l) sharings unpacks in the exponent."""
