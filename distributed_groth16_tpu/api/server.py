@@ -19,10 +19,9 @@ HTTP 500 {"error": ...} (CustomError semantics). Proofs travel as
 ark-style 128-byte compressed blobs (frontend/ark_serde.py), JSON-encoded
 as byte lists.
 
-Divergence note: witness generation from JSON `input_file` requires the
-circom WASM runtime (unavailable here — frontend/readers.py gate), so the
-witness can instead be supplied directly as a snarkjs `.wtns` upload in the
-`witness_file` field.
+Witness generation from JSON `input_file` runs the circuit's circom WASM
+on the pure-Python interpreter (frontend/wasm_vm.py); a precomputed snarkjs
+`.wtns` may alternatively be uploaded in the `witness_file` field.
 """
 
 from __future__ import annotations
@@ -96,15 +95,28 @@ class ApiServer:
             }
         )
 
-    def _witness_from_fields(self, fields, r1cs) -> list[int]:
+    def _witness_from_fields(self, fields, r1cs, circuit_id=None) -> list[int]:
         if "witness_file" in fields:
             z = read_wtns(fields["witness_file"])
         elif "input_file" in fields:
-            raise NotImplementedError(
-                "witness generation from JSON inputs requires the circom "
-                "WASM runtime, which is unavailable; upload a snarkjs "
-                ".wtns file in the witness_file field instead"
-            )
+            # the reference's primary prove flow (mpc-api/src/main.rs:282-421):
+            # JSON inputs -> circom WASM witness generation (here on the
+            # pure-Python interpreter, frontend/wasm_vm.py)
+            import json
+
+            from ..frontend.witness_calculator import WitnessCalculator
+
+            _, wasm = self.store.get_files(circuit_id)
+            if not wasm:
+                raise ValueError(
+                    "circuit was saved without a witness_generator wasm; "
+                    "upload a .wtns in the witness_file field instead"
+                )
+            # WitnessCalculator flattens nested arrays and int()s string
+            # leaves itself — pass the parsed JSON through unmodified
+            inputs = json.loads(fields["input_file"].decode())
+            wc = WitnessCalculator(wasm)
+            z = wc.calculate_witness(inputs)
         else:
             raise ValueError("need witness_file or input_file")
         if len(z) != r1cs.num_wires or not r1cs.is_satisfied(z):
@@ -117,7 +129,9 @@ class ApiServer:
             fields = await _read_multipart(request)
             circuit_id = fields["circuit_id"].decode()
             r1cs, pk = await asyncio.to_thread(self.store.load, circuit_id)
-            z = self._witness_from_fields(fields, r1cs)
+            z = await asyncio.to_thread(
+                self._witness_from_fields, fields, r1cs, circuit_id
+            )
 
             def run():
                 comp = CompiledR1CS(r1cs)
@@ -141,7 +155,9 @@ class ApiServer:
             circuit_id = fields["circuit_id"].decode()
             l = int(fields.get("l", b"2").decode())
             r1cs, pk = await asyncio.to_thread(self.store.load, circuit_id)
-            z = self._witness_from_fields(fields, r1cs)
+            z = await asyncio.to_thread(
+                self._witness_from_fields, fields, r1cs, circuit_id
+            )
 
             def run():
                 timings = PhaseTimings()

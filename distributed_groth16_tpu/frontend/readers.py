@@ -9,10 +9,10 @@ standard-form integers (BN254 only, as in the reference,
 r1cs_reader.rs:163-189).
 
 WASM witness calculation (the reference's wasmer-based WitnessCalculator,
-ark-circom/src/witness/witness_calculator.rs) requires a WASM runtime; this
-environment ships none, so `WitnessCalculator` raises with guidance unless a
-`wasmtime` module is importable. Witnesses can always be supplied via
-`.wtns` files or the native frontend (frontend/r1cs.py).
+ark-circom/src/witness/witness_calculator.rs) runs on the vendored
+pure-Python interpreter (wasm_vm.py — no host WASM runtime ships in this
+environment). Witnesses can also be supplied via `.wtns` files or the
+native frontend (frontend/r1cs.py).
 """
 
 from __future__ import annotations
@@ -224,24 +224,6 @@ def write_wtns(assignment: list[int]) -> bytes:
     return buf.getvalue()
 
 
-class WitnessCalculator:
-    """Circom WASM witness calculator (gated on a host WASM runtime).
-
-    The reference runs circom-emitted `.wasm` under wasmer
-    (witness_calculator.rs:17); no WASM runtime ships in this image, so this
-    class raises at construction unless `wasmtime` is importable. The rest of
-    the framework never requires it: witnesses flow in via `.wtns` files or
-    the native ConstraintSystem frontend.
-    """
-
-    def __init__(self, wasm_path: str):
-        try:
-            import wasmtime  # noqa: F401
-        except ImportError as e:
-            raise NotImplementedError(
-                "circom WASM witness calculation needs the `wasmtime` "
-                "package, which is not available in this environment; "
-                "supply a `.wtns` witness file (read_wtns) or build the "
-                "circuit with frontend.r1cs.ConstraintSystem instead"
-            ) from e
-        raise NotImplementedError("wasmtime backend not yet implemented")
+# Circom WASM witness calculation runs on the vendored pure-Python WASM
+# interpreter (wasm_vm.py) — the wasmer role of witness_calculator.rs:17.
+from .witness_calculator import WitnessCalculator  # noqa: E402,F401

@@ -136,6 +136,31 @@ class PartyProofShare:
     c: jnp.ndarray  # (3, 16) G1
 
 
+def _a_completion(pk):
+    """a_query[0] + alpha_g1 — the public term completing a party's S-MSM
+    to the full A. Single definition shared by the zk C-term and
+    reassemble_proof: they MUST agree or randomized proofs stop verifying
+    (sha256.rs:208-212)."""
+    C1 = g1()
+    return C1.add(pk.a_query[0], C1.encode([pk.vk.alpha_g1])[0])
+
+
+def public_prove_consts(pk) -> dict:
+    """The clear CRS values every server receives for a randomized proof
+    (prove.rs:9,51,90 — L/N/Z/K/A/M are public inputs to the per-party
+    compute): N = delta_g1, K = delta_g2, and the constant-wire-completed
+    alpha / beta terms that enter A and C."""
+    C2 = g2()
+    return {
+        "N": pk.delta_g1,
+        "K": C2.encode([pk.vk.delta_g2])[0],
+        "A0": _a_completion(pk),
+        # beta_g1 + b_g1_query[0]: with the H-query d_msm over
+        # b_g1_query[1:], r*(M + h_msm) = r*B_g1 - r*s*delta exactly
+        "M": g1().add(pk.beta_g1, pk.b_g1_query[0]),
+    }
+
+
 async def distributed_prove_party(
     pp: PackedSharingParams,
     crs_share: PackedProvingKeyShare,
@@ -143,15 +168,24 @@ async def distributed_prove_party(
     a_share: jnp.ndarray,
     ax_share: jnp.ndarray,
     net: Net,
+    pub: dict | None = None,
+    r: int = 0,
+    s: int = 0,
 ) -> PartyProofShare:
     """One party's full proving round (the dsha256 template,
-    sha256.rs:26-99): h, then A, B, C."""
+    sha256.rs:26-99): h, then A, B, C. For a zero-knowledge proof pass
+    r, s != 0 together with `pub` = public_prove_consts(pk)."""
+    zk = (r % fr().p, s % fr().p) != (0, 0)
+    if zk and pub is None:
+        raise ValueError("randomized proof needs pub=public_prove_consts(pk)")
     h_share = await ext_wit_h(qap_share, pp, net)
     # A and B are independent distributed rounds — overlap them on separate
     # channels (the reference runs them back-to-back on channel Zero)
     pi_a, pi_b = await asyncio.gather(
-        compute_A(pp, crs_share.s, a_share, net, 0),
-        compute_B(pp, crs_share.v, a_share, net, 1),
+        compute_A(pp, crs_share.s, a_share, net, 0,
+                  N=pub["N"] if zk else None, r=r),
+        compute_B(pp, crs_share.v, a_share, net, 1,
+                  K=pub["K"] if zk else None, s=s),
     )
     pi_c = await compute_C(
         pp,
@@ -162,6 +196,10 @@ async def distributed_prove_party(
         ax_share,
         h_share,
         net,
+        A=g1().add(pi_a, pub["A0"]) if zk else None,
+        M=pub["M"] if zk else None,
+        r=r,
+        s=s,
     )
     return PartyProofShare(a=pi_a, b=pi_b, c=pi_c)
 
@@ -226,7 +264,7 @@ def reassemble_proof(share: PartyProofShare, pk: ProvingKey) -> Proof:
     """Final client-side assembly (sha256.rs:208-212): add the constant-wire
     query terms and the vk offsets, decode to host affine."""
     C1, C2 = g1(), g2()
-    a = C1.add(share.a, C1.add(pk.a_query[0], C1.encode([pk.vk.alpha_g1])[0]))
+    a = C1.add(share.a, _a_completion(pk))
     b = C2.add(
         share.b, C2.add(pk.b_g2_query[0], C2.encode([pk.vk.beta_g2])[0])
     )

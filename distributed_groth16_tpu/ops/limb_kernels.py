@@ -1,0 +1,611 @@
+"""Limb-major Pallas TPU kernels for BN254 field + G1 arithmetic.
+
+This is the TPU fast path for the prover's dominant kernel, the MSM (the
+reference's per-party hot loop is arkworks `G::msm`,
+dist-primitives/src/dmsm/mod.rs:82). The row-major (..., 16)-limb layout of
+ops/field.py is right for host interop and XLA composition, but its per-op
+`moveaxis` transposes and tiny carry scans cap batched curve adds at a few
+M adds/s. Here field elements live **limb-major** — uint32 arrays of shape
+(16, n): limb index on the sublane axis, batch on the lane axis — so every
+field op is a dense (16, n) vector op with no transposes, and whole group-law
+formulas (RCB16 complete add/double) compile to single Pallas kernels that
+keep all intermediates in VMEM.
+
+Representation: Montgomery form, *redundant* residues in [0, 2p). The
+Montgomery product of inputs < 2p is < 2p (since 4p < 2^256), so `mul` is
+closed with no conditional subtract; add/sub do one conditional -2p. Values
+are canonicalised (single conditional -p) only at the boundary back to the
+row-major world.
+
+Everything here is generic over the modulus via `LimbField`, instantiated
+for BN254 Fq; the same machinery can host BLS12-381's base field.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .constants import LIMB_BITS, N_LIMBS, Q, to_limbs
+
+MASK = 0xFFFF
+NL = N_LIMBS
+
+# Pallas lane-axis tile; 2048 measured fastest for the fused add kernel on
+# v5e (1024 and 4096 are both ~25% slower; 8192 exceeds scoped VMEM).
+TILE = 2048
+
+
+def _pl():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl, pltpu
+
+
+def use_pallas() -> bool:
+    """Pallas path only on a real TPU backend; elsewhere the same body
+    functions run as plain XLA (bit-identical math)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Field bodies (pure jnp, limb-major (16, n); trace-time unrolled)
+# ---------------------------------------------------------------------------
+
+
+class LimbField:
+    """Montgomery arithmetic on limb-major uint32[16, n] in [0, 2p)."""
+
+    def __init__(self, modulus: int):
+        self.p = modulus
+        self.n0 = int((-pow(modulus, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS))
+        self.p_col = np.array(to_limbs(modulus), np.uint32).reshape(NL, 1)
+        self.p2_col = np.array(to_limbs(2 * modulus), np.uint32).reshape(NL, 1)
+        self.mont_r = (1 << 256) % modulus
+
+    # consts are passed in explicitly so the same bodies work inside Pallas
+    # kernels (which reject captured device constants).
+
+    # Each helper has two formulations with IDENTICAL op sequences (hence
+    # identical numerics): trace-time unrolled for Pallas kernels (Mosaic
+    # wants flat graphs) and `lax.scan`-rolled for the plain-XLA fallback
+    # (unrolled 3k-op graphs made CPU test compiles minutes-long).
+
+    def carry(self, v, unroll=True):
+        """(k, n) lazy rows -> (16, n) carried limbs (value < 2^256).
+
+        Rows beyond 16 (the CIOS accumulator's top row, zero by the shift
+        invariant) are dropped.
+        """
+        v = v[:NL]
+        if not unroll:
+            def step(c, row):
+                t = row + c
+                return t >> LIMB_BITS, t & MASK
+
+            _, out = jax.lax.scan(step, jnp.zeros_like(v[0]), v)
+            return out
+        rows, c = [], jnp.zeros_like(v[0:1])
+        for i in range(NL):
+            t = v[i : i + 1] + c
+            rows.append(t & MASK)
+            c = t >> LIMB_BITS
+        return jnp.concatenate(rows, axis=0)
+
+    @staticmethod
+    def _cond_sub(a, m_col, unroll=True):
+        """a - m if a >= m else a; a carried, m a (16,1) numpy/jnp column."""
+        if not unroll:
+            def step(b, xs):
+                ai, mi = xs
+                t = ai - mi - b
+                return t >> 31, t & MASK
+
+            b, d = jax.lax.scan(
+                step, jnp.zeros_like(a[0]), (a, m_col * jnp.ones_like(a))
+            )
+            return jnp.where(b == 0, d, a)
+        rows, b = [], jnp.zeros_like(a[0:1])
+        for i in range(NL):
+            t = a[i : i + 1] - m_col[i] - b
+            rows.append(t & MASK)
+            b = t >> 31
+        d = jnp.concatenate(rows, axis=0)
+        return jnp.where(b == 0, d, a)
+
+    def add(self, a, b, p2, unroll=True):
+        """(a + b) mod* : inputs < 2p -> output < 2p."""
+        return self._cond_sub(self.carry(a + b, unroll), p2, unroll)
+
+    def neg(self, b, p2, unroll=True):
+        """2p - b (the additive inverse in the redundant class), b < 2p."""
+        if not unroll:
+            def step(brw, xs):
+                bi, pi = xs
+                t = pi - bi - brw
+                return t >> 31, t & MASK
+
+            _, out = jax.lax.scan(
+                step, jnp.zeros_like(b[0]), (b, p2 * jnp.ones_like(b))
+            )
+            return out
+        rows, brw = [], jnp.zeros_like(b[0:1])
+        for i in range(NL):
+            t = p2[i] - b[i : i + 1] - brw
+            rows.append(t & MASK)
+            brw = t >> 31
+        return jnp.concatenate(rows, axis=0)
+
+    def sub(self, a, b, p2, unroll=True):
+        return self._cond_sub(
+            self.carry(a + self.neg(b, p2, unroll), unroll), p2, unroll
+        )
+
+    def mul(self, a, b, p, unroll=True):
+        """Montgomery product, CIOS with lazy carries; inputs < 2p (limbs
+        <= 0xffff) -> output < 2p. 16 rounds of dense (16, n) ops, one
+        final carry chain, no conditional subtract."""
+        n = a.shape[-1]
+        z1 = jnp.zeros((1, n), jnp.uint32)
+
+        def step(v, ai):
+            prod = ai * b  # (16, n); both operands <= 0xffff
+            # rows 1..15 receive lo[1:] + hi[:-1]: merge before widening
+            mid = (prod[1:] & MASK) + (prod[:-1] >> LIMB_BITS)
+            contrib = jnp.concatenate(
+                [prod[0:1] & MASK, mid, prod[15:16] >> LIMB_BITS], axis=0
+            )
+            v = v + contrib
+            m = (v[0:1] * self.n0) & MASK
+            qp = m * p
+            qmid = (qp[1:] & MASK) + (qp[:-1] >> LIMB_BITS)
+            qcontrib = jnp.concatenate(
+                [qp[0:1] & MASK, qmid, qp[15:16] >> LIMB_BITS], axis=0
+            )
+            v = v + qcontrib
+            return jnp.concatenate(
+                [v[1:2] + (v[0:1] >> LIMB_BITS), v[2:], z1], axis=0
+            )
+
+        v0 = jnp.zeros((NL + 1, n), jnp.uint32)
+        if not unroll:
+            v, _ = jax.lax.scan(
+                lambda v, ai: (step(v, ai[None]), None), v0, a[:NL]
+            )
+            return self.carry(v, unroll=False)
+        v = v0
+        for i in range(NL):
+            v = step(v, a[i : i + 1])
+        return self.carry(v)
+
+    def canon(self, a):
+        """[0, 2p) carried -> canonical [0, p)."""
+        return self._cond_sub(a, jnp.asarray(self.p_col))
+
+
+@functools.cache
+def lfq() -> LimbField:
+    return LimbField(Q)
+
+
+# ---------------------------------------------------------------------------
+# G1 group law bodies on limb-major points (48, n): rows 0-15 X, 16-31 Y,
+# 32-47 Z (projective, RCB16 complete formulas, a = 0)
+# ---------------------------------------------------------------------------
+
+
+class LimbG1:
+    """BN254 G1 on limb-major uint32[48, n]; b = 3, b3 = 9."""
+
+    ROWS = 48
+
+    def __init__(self, field: LimbField | None = None, b: int = 3):
+        self.F = field or lfq()
+        b3 = 3 * b * self.F.mont_r % self.F.p
+        # consts block handed to every kernel: rows 0-15 p, 16-31 2p, 32-47 b3
+        self.consts_np = np.concatenate(
+            [
+                self.F.p_col,
+                self.F.p2_col,
+                np.array(to_limbs(b3), np.uint32).reshape(NL, 1),
+            ],
+            axis=0,
+        )
+        one = np.array(to_limbs(self.F.mont_r), np.uint32)
+        inf = np.zeros((48,), np.uint32)
+        inf[16:32] = one
+        self.inf_col = inf.reshape(48, 1)
+
+    # -- bodies -------------------------------------------------------------
+
+    def add_body(self, p3, q3, consts, unroll=True):
+        F = self.F
+        p, p2, b3c = consts[0:16], consts[16:32], consts[32:48]
+        mul = lambda x, y: F.mul(x, y, p, unroll)
+        add = lambda x, y: F.add(x, y, p2, unroll)
+        sub = lambda x, y: F.sub(x, y, p2, unroll)
+        X1, Y1, Z1 = p3[0:16], p3[16:32], p3[32:48]
+        X2, Y2, Z2 = q3[0:16], q3[16:32], q3[32:48]
+        t0 = mul(X1, X2)
+        t1 = mul(Y1, Y2)
+        t2 = mul(Z1, Z2)
+        t3 = sub(mul(add(X1, Y1), add(X2, Y2)), add(t0, t1))
+        t4 = sub(mul(add(Y1, Z1), add(Y2, Z2)), add(t1, t2))
+        ty = sub(mul(add(X1, Z1), add(X2, Z2)), add(t0, t2))
+        t0_3 = add(add(t0, t0), t0)
+        t2b = mul(t2, b3c)
+        yb = mul(ty, b3c)
+        Z3 = add(t1, t2b)
+        t1m = sub(t1, t2b)
+        X3 = sub(mul(t3, t1m), mul(t4, yb))
+        Y3 = add(mul(yb, t0_3), mul(t1m, Z3))
+        Z3o = add(mul(Z3, t4), mul(t0_3, t3))
+        return jnp.concatenate([X3, Y3, Z3o], axis=0)
+
+    def double_body(self, p3, consts, unroll=True):
+        F = self.F
+        p, p2, b3c = consts[0:16], consts[16:32], consts[32:48]
+        mul = lambda x, y: F.mul(x, y, p, unroll)
+        add = lambda x, y: F.add(x, y, p2, unroll)
+        sub = lambda x, y: F.sub(x, y, p2, unroll)
+        X, Y, Z = p3[0:16], p3[16:32], p3[32:48]
+        t0 = mul(Y, Y)
+        t1 = mul(Y, Z)
+        t2 = mul(Z, Z)
+        txy = mul(X, Y)
+        z8 = add(t0, t0)
+        z8 = add(z8, z8)
+        z8 = add(z8, z8)  # 8 Y^2
+        t2b = mul(t2, b3c)
+        y3a = add(t0, t2b)
+        t0m = sub(t0, add(add(t2b, t2b), t2b))
+        X3g = mul(t2b, z8)
+        Z3 = mul(t1, z8)
+        Y3m = mul(t0m, y3a)
+        X3m = mul(t0m, txy)
+        Y3 = add(X3g, Y3m)
+        X3 = add(X3m, X3m)
+        return jnp.concatenate([X3, Y3, Z3], axis=0)
+
+    def neg_body(self, p3, consts):
+        p2 = consts[16:32]
+        return jnp.concatenate(
+            [p3[0:16], self.F.neg(p3[16:32], p2), p3[32:48]], axis=0
+        )
+
+    # -- pallas / XLA dispatch ---------------------------------------------
+
+    def _consts(self):
+        return jnp.asarray(self.consts_np)
+
+    @functools.cached_property
+    def _xla_add(self):
+        return jax.jit(
+            lambda p, q: self.add_body(p, q, self._consts(), unroll=False)
+        )
+
+    @functools.cached_property
+    def _xla_double(self):
+        return jax.jit(
+            lambda p: self.double_body(p, self._consts(), unroll=False)
+        )
+
+    @functools.cached_property
+    def _pallas_add(self):
+        pl, pltpu = _pl()
+
+        def kern(p_ref, q_ref, c_ref, o_ref):
+            o_ref[:] = self.add_body(p_ref[:], q_ref[:], c_ref[:])
+
+        @jax.jit
+        def run(p, q):
+            n = p.shape[1]
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((48, n), jnp.uint32),
+                grid=(n // TILE,),
+                in_specs=[
+                    pl.BlockSpec((48, TILE), lambda i: (0, i),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((48, TILE), lambda i: (0, i),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((48, 1), lambda i: (0, 0),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((48, TILE), lambda i: (0, i),
+                                       memory_space=pltpu.VMEM),
+            )(p, q, self._consts())
+
+        return run
+
+    @functools.cached_property
+    def _pallas_double(self):
+        pl, pltpu = _pl()
+
+        def kern(p_ref, c_ref, o_ref):
+            o_ref[:] = self.double_body(p_ref[:], c_ref[:])
+
+        @jax.jit
+        def run(p):
+            n = p.shape[1]
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((48, n), jnp.uint32),
+                grid=(n // TILE,),
+                in_specs=[
+                    pl.BlockSpec((48, TILE), lambda i: (0, i),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((48, 1), lambda i: (0, 0),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((48, TILE), lambda i: (0, i),
+                                       memory_space=pltpu.VMEM),
+            )(p)
+
+        return run
+
+    def _batched(self, fn_pallas, fn_xla, args):
+        """Flatten trailing batch axes, pad the lane axis to a power-of-two
+        width, run. Power-of-two padding bounds the number of distinct
+        compiled shapes (the unrolled group-law graphs are large, so each
+        extra shape is a real compile cost on both CPU and TPU)."""
+        shape = args[0].shape
+        flat = [a.reshape(48, -1) for a in args]
+        n = flat[0].shape[1]
+        pallas = use_pallas()
+        granule = TILE if pallas else 256
+        npad = max(granule, 1 << (n - 1).bit_length())
+        if npad != n:
+            flat = [jnp.pad(a, ((0, 0), (0, npad - n))) for a in flat]
+        out = (fn_pallas if pallas else fn_xla)(*flat)[:, :n]
+        return out.reshape(shape)
+
+    def add(self, p, q):
+        """Complete add on (48, ...) limb-major batches."""
+        q = jnp.broadcast_to(q, p.shape)
+        return self._batched(self._pallas_add, self._xla_add, (p, q))
+
+    def double(self, p):
+        return self._batched(self._pallas_double, self._xla_double, (p,))
+
+    def neg(self, p):
+        return self.neg_body(p.reshape(48, -1), self._consts()).reshape(p.shape)
+
+    # -- window combine (Horner over c-bit windows), one fused kernel -------
+
+    def horner_body(self, getcol, consts, c: int, W: int, unroll=True):
+        """acc = sum_w 2^(c*w) * S_w; getcol(w) -> (48, 1) window sum."""
+        acc0 = jnp.broadcast_to(getcol(W - 1), (48, 128))
+
+        def step(i, acc):
+            w = W - 2 - i
+            for _ in range(c):
+                acc = self.double_body(acc, consts, unroll)
+            return self.add_body(
+                acc, jnp.broadcast_to(getcol(w), (48, 128)), consts, unroll
+            )
+
+        return jax.lax.fori_loop(0, W - 1, step, acc0)
+
+    @functools.cache
+    def _horner(self, c: int, W: int):
+        if not use_pallas():
+            return jax.jit(
+                lambda s: self.horner_body(
+                    lambda w: jax.lax.dynamic_slice(s, (0, w), (48, 1)),
+                    self._consts(), c, W, unroll=False,
+                )[:, :1]
+            )
+        pl, pltpu = _pl()
+
+        def kern(s_ref, c_ref, o_ref):
+            s = s_ref[:]
+            lane = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+
+            def getcol(w):
+                # dynamic width-1 lane slices (and unsigned reductions)
+                # don't lower in Mosaic; mask + signed lane-reduce does
+                masked = jnp.where(lane == w, s, jnp.uint32(0)).astype(
+                    jnp.int32
+                )
+                return jnp.sum(masked, axis=1, keepdims=True).astype(
+                    jnp.uint32
+                )
+
+            o_ref[:] = self.horner_body(getcol, c_ref[:], c, W)
+
+        @jax.jit
+        def run(s):
+            out = pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((48, 128), jnp.uint32),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            )(s, self._consts())
+            return out[:, :1]
+
+        return run
+
+    def horner(self, s, c: int):
+        """Window sums s (48, W), LSB window first -> single point (48, 1)."""
+        W = s.shape[1]
+        if W == 1:
+            return s
+        return self._horner(c, W)(s)
+
+    # -- layout conversion ---------------------------------------------------
+
+    def from_rowmajor(self, pts):
+        """(n, 3, 16) row-major (canonical Montgomery) -> (48, n)."""
+        n = pts.shape[0]
+        return jnp.transpose(pts.reshape(n, 48))
+
+    def to_rowmajor(self, lm, canonical: bool = True):
+        """(48, n) -> (n, 3, 16) row-major; canonicalises to [0, p)."""
+        if canonical:
+            lm = jnp.concatenate(
+                [self.F.canon(lm[i * 16 : (i + 1) * 16]) for i in range(3)],
+                axis=0,
+            )
+        return jnp.transpose(lm).reshape(-1, 3, 16)
+
+    def infinity(self, n: int):
+        return jnp.broadcast_to(jnp.asarray(self.inf_col), (48, n))
+
+
+@functools.cache
+def lg1() -> LimbG1:
+    return LimbG1()
+
+
+# ---------------------------------------------------------------------------
+# Tree MSM: sorted-digit buckets, pairwise sum tree + Fenwick prefix queries
+# ---------------------------------------------------------------------------
+
+
+def _digits(scalars_std, c: int):
+    """(n, 16) standard-form u32 limbs -> (W, n) int32 c-bit digits, LSB
+    window first. c must divide 16."""
+    assert LIMB_BITS % c == 0
+    per = LIMB_BITS // c
+    parts = [
+        ((scalars_std >> (k * c)) & ((1 << c) - 1)) for k in range(per)
+    ]  # each (n, 16)
+    inter = jnp.stack(parts, axis=-1).reshape(scalars_std.shape[0], 16 * per)
+    return jnp.transpose(inter).astype(jnp.int32)  # (W, n)
+
+
+def msm_tree(points_rm, scalars_std, c: int | None = None,
+             window_group: int | None = None):
+    """sum_i scalars[i] * points[i] on BN254 G1, limb-major TPU path.
+
+    points_rm: (n, 3, 16) projective row-major (Montgomery, canonical);
+    scalars_std: (n, 16) uint32 standard form. Returns (3, 16) row-major
+    canonical projective point.
+
+    Per window: points are ordered by digit (argsort), reduced by a pairwise
+    sum tree (n-1 adds — vs 2n for an associative_scan — with every level a
+    dense Pallas add over all windows at once), and the B-1 bucket prefix
+    sums C_j are read off the tree Fenwick-style: C(pos) =
+    sum_{d: bit d of pos} level_d[(pos >> d) - 1]. The weighted-bucket
+    identity sum_b b*S_b = sum_j (total - C_j) then needs one batched
+    neg+add and a small tree sum; windows combine in one fused Horner
+    kernel. Matches the role of arkworks G::msm (dmsm/mod.rs:82).
+
+    The whole computation is one jitted program: per-dispatch host latency
+    (milliseconds through the remote-TPU tunnel) would otherwise dominate
+    the ~30 narrow query/combine steps.
+    """
+    if c is None:
+        # the Fenwick/combine stages scale with B = 2^c per window: a small
+        # MSM with c=8 would spend everything on 255 empty buckets
+        c = 8 if points_rm.shape[0] >= 4096 else 4
+    return _msm_tree_jit(points_rm, scalars_std, c, window_group)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _msm_tree_jit(points_rm, scalars_std, c: int, window_group: int | None):
+    g = lg1()
+    n = points_rm.shape[0]
+    W_all = 256 // c
+    B = 1 << c
+    npad = 1 << max(1, (n - 1).bit_length())
+    lm = g.from_rowmajor(points_rm)
+    if npad != n:
+        lm = jnp.concatenate([lm, g.infinity(npad - n)], axis=1)
+    digits = _digits(scalars_std, c)  # (W, n)
+    if npad != n:
+        digits = jnp.pad(digits, ((0, 0), (0, npad - n)))
+    levels_n = npad.bit_length() - 1  # log2(npad)
+
+    if window_group is None:
+        # bound live tree memory to ~8 * 48 * 2^20 * 4 * 2 ≈ 3.2 GB
+        window_group = W_all if npad <= (1 << 17) else 8
+
+    sums = []
+    for w0 in range(0, W_all, window_group):
+        dg = digits[w0 : w0 + window_group]  # (Wg, npad)
+        Wg = dg.shape[0]
+        order = jnp.argsort(dg, axis=-1)
+        sortd = jnp.take_along_axis(dg, order, axis=-1)
+        ends = jax.vmap(
+            lambda row: jnp.searchsorted(row, jnp.arange(B - 1), side="right")
+        )(sortd)  # (Wg, B-1)
+        gathered = jnp.take(lm, order.reshape(-1), axis=1).reshape(48, Wg, npad)
+
+        # Up-sweep; each level is also kept transposed to (Wg*K, 48) so the
+        # Fenwick node lookups below are contiguous 192-byte row gathers
+        # (embedding-style) instead of 48-way strided minor-axis gathers.
+        lvls_t = []
+        x = gathered
+        lvls_t.append(jnp.transpose(x, (1, 2, 0)).reshape(-1, 48))
+        for _ in range(levels_n):
+            k = x.shape[-1]
+            pair = x.reshape(48, Wg, k // 2, 2)
+            x = g.add(pair[..., 0], pair[..., 1])
+            lvls_t.append(jnp.transpose(x, (1, 2, 0)).reshape(-1, 48))
+        total = x[..., 0:1]  # (48, Wg, 1)
+
+        # Fenwick prefix at the B-1 bucket boundaries: gather one node per
+        # level per boundary, then sum the levels with a pairwise tree.
+        inf_row = jnp.asarray(g.inf_col)[:, 0]  # (48,)
+        nodes = []
+        for d in range(levels_n + 1):
+            pd = ends >> d
+            takebit = (pd & 1) == 1
+            idx = jnp.maximum(pd - 1, 0)
+            k = npad >> d
+            flat = (jnp.arange(Wg)[:, None] * k + idx).reshape(-1)
+            node = jnp.take(lvls_t[d], flat, axis=0).reshape(Wg, B - 1, 48)
+            node = jnp.where(takebit[..., None], node, inf_row)
+            nodes.append(node)
+        D = len(nodes)
+        dpad = 1 << (D - 1).bit_length()
+        stack = jnp.stack(nodes, axis=0)  # (D, Wg, B-1, 48)
+        if dpad != D:
+            stack = jnp.concatenate(
+                [
+                    stack,
+                    jnp.broadcast_to(inf_row, (dpad - D, Wg, B - 1, 48)),
+                ],
+                axis=0,
+            )
+        stack = jnp.transpose(stack, (3, 0, 1, 2))  # (48, dpad, Wg, B-1)
+        while stack.shape[1] > 1:
+            half = stack.shape[1] // 2
+            stack = g.add(stack[:, :half], stack[:, half:])
+        acc = stack[:, 0]  # (48, Wg, B-1)
+
+        # sum_b b * S_b = sum_{j=0..B-2} (total - C_j)
+        terms = g.add(jnp.broadcast_to(total, acc.shape), g.neg(acc))
+        k = B - 1
+        while k > 1:
+            if k % 2:
+                terms = jnp.concatenate(
+                    [
+                        terms,
+                        jnp.broadcast_to(
+                            jnp.asarray(g.inf_col)[:, :, None], (48, Wg, 1)
+                        ),
+                    ],
+                    axis=-1,
+                )
+                k += 1
+            pair = terms.reshape(48, Wg, k // 2, 2)
+            terms = g.add(pair[..., 0], pair[..., 1])
+            k //= 2
+        sums.append(terms[..., 0])  # (48, Wg)
+
+    s_all = jnp.concatenate(sums, axis=1)  # (48, W_all)
+    out = g.horner(s_all, c)  # (48, 1)
+    return g.to_rowmajor(out)[0]

@@ -85,6 +85,21 @@ def _msm_jit(curve: CurvePoints, points, scalars, c: int):
     return jax.lax.fori_loop(0, W, body, inf)
 
 
+def _tree_path_ok(curve: CurvePoints, n: int) -> bool:
+    """Route G1 MSMs to the limb-major tree path (ops/limb_kernels.py) on
+    TPU backends — the Pallas fast path — or anywhere when forced via
+    DG16_FORCE_TREE_MSM=1 (tests exercise the identical XLA bodies on CPU)."""
+    import os
+
+    if curve.elem_shape != (N_LIMBS,):
+        return False  # G2 / Fq2 curves stay on the generic path for now
+    if os.environ.get("DG16_FORCE_TREE_MSM") == "1":
+        return True
+    from .limb_kernels import use_pallas
+
+    return use_pallas() and n >= 1024
+
+
 def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
         chunk: int | None = None):
     """sum_i scalars[i] * points[i].
@@ -99,6 +114,12 @@ def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
     """
     n = points.shape[0]
     assert scalars.shape[-1] == N_LIMBS and scalars.shape[0] == n
+    # explicit window_bits/chunk pin the generic path (chunk in particular
+    # is a memory bound the tree path would silently drop)
+    if window_bits is None and chunk is None and _tree_path_ok(curve, n):
+        from .limb_kernels import msm_tree
+
+        return msm_tree(points, scalars)
     if window_bits is None:
         # the sort+scan bucketing costs ~n log n adds per window, so fewer,
         # wider windows win once n dwarfs the 2^c bucket-combine cost

@@ -69,6 +69,6 @@ def test_read_wtns():
     assert all(0 <= x < R for x in w)
 
 
-def test_witness_calculator_gated():
-    with pytest.raises(NotImplementedError, match="wasmtime"):
-        WitnessCalculator("whatever.wasm")
+def test_witness_calculator_rejects_non_wasm():
+    with pytest.raises(AssertionError, match="wasm magic"):
+        WitnessCalculator(b"not a wasm module")

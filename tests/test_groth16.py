@@ -112,3 +112,39 @@ def test_proving_key_save_load(world, tmp_path):
     assert pk2.vk.gamma_abc_g1 == pk.vk.gamma_abc_g1
     assert jnp.array_equal(pk2.a_query, pk.a_query)
     assert jnp.array_equal(pk2.b_g2_query, pk.b_g2_query)
+
+
+def test_zk_proof_r_s_nonzero_verifies(world):
+    """Randomized (zero-knowledge) MPC proof: r, s != 0 exercises the
+    N/K/A/M public terms and the H-query d_msm round (prove.rs:10-137 runs
+    it unconditionally; here it only runs when r != 0)."""
+    from distributed_groth16_tpu.models.groth16.prove import (
+        public_prove_consts,
+    )
+
+    pp, pk, r1cs, z = world["pp"], world["pk"], world["r1cs"], world["z"]
+    qap_shares = world["qap"].pss(pp)
+    crs_shares = pack_proving_key(pk, pp)
+    ni = r1cs.num_instance
+    a_shares = pack_from_witness(pp, world["z_mont"][1:])
+    ax_shares = pack_from_witness(pp, world["z_mont"][ni:])
+    pub = public_prove_consts(pk)
+    r, s = 123456789, 987654321
+
+    async def party(net, data):
+        crs, qs, a_s, ax_s = data
+        return await distributed_prove_party(
+            pp, crs, qs, a_s, ax_s, net, pub=pub, r=r, s=s
+        )
+
+    data = [
+        (crs_shares[i], qap_shares[i], a_shares[i], ax_shares[i])
+        for i in range(pp.n)
+    ]
+    result = simulate_network_round(pp.n, party, data)
+    proof = reassemble_proof(result[0], pk)
+
+    publics = z[1:ni]
+    assert verify(pk.vk, proof, publics), "randomized proof failed pairing"
+    det = prove_host(pk, r1cs, z)
+    assert proof.a != det.a, "r != 0 must randomize A"
