@@ -82,28 +82,11 @@ def _init_backend():
 def main() -> None:
     jax, platform = _init_backend()
     # persistent compile cache: first-time kernel compiles are minutes-scale;
-    # pay once per machine, not once per driver round
-    import hashlib
+    # pay once per machine, not once per driver round (utils/cache.py
+    # partitions by CPU fingerprint — foreign AOT entries SIGILL)
+    from distributed_groth16_tpu.utils.cache import setup_compile_cache
 
-    try:
-        with open("/proc/cpuinfo") as f:
-            flags = next(
-                (ln for ln in f if ln.startswith("flags")), "unknown"
-            )
-    except OSError:
-        flags = "unknown"
-    # partition by CPU feature fingerprint: XLA:CPU AOT cache entries from a
-    # host with different vector features SIGILL on load
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            ".jax_cache",
-            hashlib.sha1(flags.encode()).hexdigest()[:12],
-        ),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    setup_compile_cache(jax, os.path.dirname(os.path.abspath(__file__)))
     import jax.numpy as jnp
     import numpy as np
 

@@ -385,6 +385,11 @@ class Instance:
         m = self.m
 
         def do_branch(depth, pc):
+            if depth >= len(frames):
+                # branch to the implicit function-level label: return from
+                # the function (results are the top-of-stack values)
+                frames.clear()
+                return ncode
             for _ in range(depth):
                 frames.pop()
             is_loop, target, height, arity = frames[-1]
@@ -648,7 +653,9 @@ class Instance:
                 a = _s32(stack[-1])
                 if v == 0:
                     raise WasmTrap("division by zero")
-                stack[-1] = int(a / v) & M32  # trunc toward zero
+                if a == -(1 << 31) and v == -1:
+                    raise WasmTrap("integer overflow")
+                stack[-1] = _idiv_trunc(a, v) & M32
             elif op == 0x6E:  # i32.div_u
                 v = stack.pop()
                 if v == 0:
@@ -659,7 +666,7 @@ class Instance:
                 a = _s32(stack[-1])
                 if v == 0:
                     raise WasmTrap("division by zero")
-                stack[-1] = (a - int(a / v) * v) & M32
+                stack[-1] = (a - _idiv_trunc(a, v) * v) & M32
             elif op == 0x70:  # i32.rem_u
                 v = stack.pop()
                 if v == 0:
@@ -710,7 +717,9 @@ class Instance:
                 a = _s64(stack[-1])
                 if v == 0:
                     raise WasmTrap("division by zero")
-                stack[-1] = int(a / v) & M64
+                if a == -(1 << 63) and v == -1:
+                    raise WasmTrap("integer overflow")
+                stack[-1] = _idiv_trunc(a, v) & M64
             elif op == 0x80:  # i64.div_u
                 v = stack.pop()
                 if v == 0:
@@ -721,7 +730,7 @@ class Instance:
                 a = _s64(stack[-1])
                 if v == 0:
                     raise WasmTrap("division by zero")
-                stack[-1] = (a - int(a / v) * v) & M64
+                stack[-1] = (a - _idiv_trunc(a, v) * v) & M64
             elif op == 0x82:  # i64.rem_u
                 v = stack.pop()
                 if v == 0:
@@ -759,6 +768,13 @@ class Instance:
                 raise WasmTrap(f"unsupported opcode {op:#x}")
         return stack
 
+
+
+def _idiv_trunc(a: int, v: int) -> int:
+    """Truncating (toward-zero) signed integer division — exact for the
+    full i64 range (float-based int(a / v) loses precision above 2^53)."""
+    q = abs(a) // abs(v)
+    return -q if (a < 0) != (v < 0) else q
 
 def _s32(v):
     return v - 0x100000000 if v & 0x80000000 else v

@@ -70,6 +70,16 @@ class ProvingKey:
         )
 
     @staticmethod
+    def from_zkey(path_or_bytes) -> "ProvingKey":
+        """Import a snarkjs `.zkey` (the reference's real-CRS path,
+        ark-circom/src/zkey.rs:53-60). Drops the constraint matrices —
+        use frontend.zkey.read_zkey to keep them."""
+        from ...frontend.zkey import read_zkey
+
+        pk, _ = read_zkey(path_or_bytes)
+        return pk
+
+    @staticmethod
     def load(path: str) -> "ProvingKey":
         d = np.load(path)  # no pickle: key files may cross trust boundaries
         meta = d["meta"]

@@ -87,21 +87,19 @@ def _h_query_scalars_device(tau: int, delta_inv: int, m: int) -> jnp.ndarray:
 
 
 def _g1_ladder(scalars: list[int]) -> jnp.ndarray:
-    """(k,) ints -> (k, 3, 16) projective points scalar * G1 generator, one
-    batched device ladder."""
-    C = g1()
-    bits = scalar_bits(encode_scalars_std(scalars))
-    base = jnp.broadcast_to(C.encode([G1_GENERATOR])[0], (len(scalars), 3, 16))
-    return C.scalar_mul_bits(base, bits)
+    """(k,) ints -> (k, 3, 16) projective points scalar * G1 generator via
+    the windowed fixed-base table (ops/fixedbase.py) — 31 batched adds per
+    point instead of a 256-step ladder, the scaling fix for million-size
+    setup (VERDICT r2 weak #5)."""
+    from ...ops.fixedbase import fixed_base_mul
+
+    return fixed_base_mul("g1", encode_scalars_std(scalars))
 
 
 def _g2_ladder(scalars: list[int]) -> jnp.ndarray:
-    C = g2()
-    bits = scalar_bits(encode_scalars_std(scalars))
-    base = jnp.broadcast_to(
-        C.encode([G2_GENERATOR])[0], (len(scalars), 3, 2, 16)
-    )
-    return C.scalar_mul_bits(base, bits)
+    from ...ops.fixedbase import fixed_base_mul
+
+    return fixed_base_mul("g2", encode_scalars_std(scalars))
 
 
 def setup(r1cs: R1CS, seed: int = 42) -> ProvingKey:
@@ -152,11 +150,12 @@ def setup(r1cs: R1CS, seed: int = 42) -> ProvingKey:
     b_g2_query = g2_pts[:nw]
     beta_g2_d, gamma_g2_d, delta_g2_d = g2_pts[nw], g2_pts[nw + 1], g2_pts[nw + 2]
 
-    h_scal = _h_query_scalars_device(tau, delta_inv, m)
-    h_bits = scalar_bits(fr().from_mont(h_scal))
-    C1 = g1()
-    h_base = jnp.broadcast_to(C1.encode([G1_GENERATOR])[0], (m, 3, 16))
-    h_query = C1.scalar_mul_bits(h_base, h_bits)
+    from ...ops.fixedbase import fixed_base_mul
+
+    with phase("setup: h_query fixed-base"):
+        h_scal = _h_query_scalars_device(tau, delta_inv, m)
+        C1 = g1()
+        h_query = fixed_base_mul("g1", fr().from_mont(h_scal))
 
     vk = VerifyingKey(
         alpha_g1=C1.decode(alpha_g1_d),

@@ -1,0 +1,252 @@
+"""BLS12-377 G1 — the second curve configuration.
+
+The reference benchmarks its distributed kernels over ark-bls12-377
+(dist-primitives/examples/dmsm_bench.rs:1,48; groth16/examples/
+local_groth_bench.rs), relying on arkworks for the parameters. Here every
+constant is DERIVED from the BLS12-377 seed at import and self-checked
+(primality, curve membership, subgroup order), so nothing is copied on
+trust:
+
+    x  = 0x8508C00000000001                      (the BLS parameter)
+    r  = x^4 - x^2 + 1                           (scalar field, 253 bits)
+    q  = ((x - 1)^2 * r) / 3 + x                 (base field, 377 bits)
+    G1 : y^2 = x^3 + 1 over Fq, cofactor (x-1)^2 / 3
+
+Field elements use 24x16-bit limbs (Montgomery radix 2^384 — the generic
+PrimeField of ops/field.py parameterized by limb count); scalars are Fr
+elements in the standard 16-limb layout, so the MSM/ladder machinery of
+ops/msm.py and ops/curve.py works unchanged. The G1 generator is chosen
+deterministically (smallest-x curve point, cofactor-cleared) — generator
+choice is a convention, not part of the group.
+
+No pairing/G2 here: kernel-level curve parity (MSM, FFT-in-the-exponent,
+PSS) mirrors exactly what the reference exercises over BLS12-377.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from . import refmath as rm
+from .constants import LIMB_BITS
+
+# --------------------------------------------------------------------------
+# parameter derivation from the seed
+# --------------------------------------------------------------------------
+
+X = 0x8508C00000000001
+R377 = X**4 - X**2 + 1
+Q377 = ((X - 1) ** 2 * R377) // 3 + X
+G1_B377 = 1
+G1_COFACTOR = (X - 1) ** 2 // 3
+
+FR_TWO_ADICITY_377 = ((R377 - 1) & -(R377 - 1)).bit_length() - 1  # = 47
+
+
+def _is_probable_prime(n: int, rounds: int = 40) -> bool:
+    """Deterministic-enough Miller-Rabin (fixed small bases + pseudorandom)."""
+    if n < 2:
+        return False
+    for sp in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        if n % sp == 0:
+            return n == sp
+    d, s = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        s += 1
+    import random
+
+    rng = random.Random(0xB15B377)
+    for i in range(rounds):
+        a = (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37)[i] if i < 12 else (
+            rng.randrange(2, n - 1)
+        )
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(s - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def _pollard_rho(n: int) -> int:
+    """One nontrivial factor of composite n (Brent's variant)."""
+    import math
+    import random
+
+    if n % 2 == 0:
+        return 2
+    rng = random.Random(n)
+    while True:
+        y, c, m = rng.randrange(1, n), rng.randrange(1, n), 128
+        g, r, q = 1, 1, 1
+        while g == 1:
+            x = y
+            for _ in range(r):
+                y = (y * y + c) % n
+            k = 0
+            while k < r and g == 1:
+                ys = y
+                for _ in range(min(m, r - k)):
+                    y = (y * y + c) % n
+                    q = q * abs(x - y) % n
+                g = math.gcd(q, n)
+                k += m
+            r <<= 1
+        if g == n:
+            g = 1
+            while g == 1:
+                ys = (ys * ys + c) % n
+                g = math.gcd(abs(x - ys), n)
+        if g != n:
+            return g
+
+
+def _factor(n: int) -> set[int]:
+    """Prime factors of n (recursive rho; n here has <= 64-bit parts)."""
+    if n == 1:
+        return set()
+    if _is_probable_prime(n):
+        return {n}
+    d = _pollard_rho(n)
+    return _factor(d) | _factor(n // d)
+
+
+@functools.cache
+def _fr_generator() -> int:
+    """Smallest multiplicative generator of Fr377 (arkworks convention:
+    smallest g whose order is r-1). r-1 = x^2 (x-1)(x+1) factors through
+    64-bit integers."""
+    primes = _factor(X) | _factor(X - 1) | _factor(X + 1)
+    phi = R377 - 1
+    g = 2
+    while True:
+        if all(pow(g, phi // p, R377) != 1 for p in primes):
+            return g
+        g += 1
+
+
+# --------------------------------------------------------------------------
+# self-checks (import-time; cheap)
+# --------------------------------------------------------------------------
+
+assert R377.bit_length() == 253 and Q377.bit_length() == 377
+assert ((X - 1) ** 2 * R377) % 3 == 0, "q derivation divisibility"
+assert _is_probable_prime(R377), "r not prime"
+assert _is_probable_prime(Q377), "q not prime"
+# curve/group consistency: #E(Fq) = h * r = q + 1 - t with t = x + 1
+assert G1_COFACTOR * R377 == Q377 + 1 - (X + 1), "Hasse/trace identity"
+assert (R377 - 1) % (1 << FR_TWO_ADICITY_377) == 0
+
+
+# --------------------------------------------------------------------------
+# host ground truth
+# --------------------------------------------------------------------------
+
+G1_HOST = rm._CurveOps(
+    add=lambda a, b: (a + b) % Q377,
+    sub=lambda a, b: (a - b) % Q377,
+    mul=lambda a, b: a * b % Q377,
+    sq=lambda a: a * a % Q377,
+    neg=lambda a: (-a) % Q377,
+    inv=lambda a: rm.finv(a, Q377),
+    scalar=lambda a, k: a * k % Q377,
+    zero=0,
+    one=1,
+    b=G1_B377,
+    order=R377,
+)
+
+
+def _sqrt_fq(a: int) -> int | None:
+    """Square root in Fq377 (q ≡ 1 mod 4 — Tonelli-Shanks, two-adicity 46)."""
+    if a == 0:
+        return 0
+    if pow(a, (Q377 - 1) // 2, Q377) == Q377 - 1:
+        return None  # non-residue
+    # Tonelli-Shanks
+    s = ((Q377 - 1) & -(Q377 - 1)).bit_length() - 1
+    qodd = (Q377 - 1) >> s
+    # any quadratic non-residue works as the generator
+    z = 2
+    while pow(z, (Q377 - 1) // 2, Q377) != Q377 - 1:
+        z += 1
+    m, c = s, pow(z, qodd, Q377)
+    t, r = pow(a, qodd, Q377), pow(a, (qodd + 1) // 2, Q377)
+    while t != 1:
+        t2, i = t, 0
+        while t2 != 1:
+            t2 = t2 * t2 % Q377
+            i += 1
+        b = pow(c, 1 << (m - i - 1), Q377)
+        m, c = i, b * b % Q377
+        t, r = t * c % Q377, r * b % Q377
+    return r
+
+
+@functools.cache
+def g1_generator_377() -> tuple[int, int]:
+    """Deterministic G1 generator: smallest x with x^3 + 1 square, smaller
+    root, cofactor-cleared into the r-torsion."""
+    gx = 0
+    while True:
+        rhs = (gx * gx * gx + G1_B377) % Q377
+        y = _sqrt_fq(rhs)
+        if y is not None:
+            pt = G1_HOST.scalar_mul((gx, min(y, Q377 - y)), G1_COFACTOR)
+            if pt is not None:
+                assert G1_HOST.is_on_curve(pt)
+                assert G1_HOST.scalar_mul(pt, R377) is None, "not r-torsion"
+                return pt
+        gx += 1
+
+
+# --------------------------------------------------------------------------
+# device instances
+# --------------------------------------------------------------------------
+
+
+@functools.cache
+def fq377():
+    from .field import PrimeField
+
+    return PrimeField(Q377)  # 24 limbs, Montgomery radix 2^384
+
+
+@functools.cache
+def fr377():
+    from .field import PrimeField
+
+    return PrimeField(R377)  # 16 limbs, same scalar layout as BN254
+
+
+@functools.cache
+def g1_377():
+    """BLS12-377 G1 CurvePoints — plugs into ops/msm.py and the generic
+    curve machinery (fixed-scalar ladders reduce mod this curve's own r).
+    The PSS/pointNTT layers still assume BN254 Fr domains (their NTT
+    tables are built over ops/constants.R) — curve-generic packed sharing
+    is tracked as follow-up work, matching the reference's BLS usage
+    (plain d_msm benches, dmsm_bench.rs:42-50)."""
+    from .curve import CurvePoints
+
+    nl = fq377().nl
+    return CurvePoints(fq377(), G1_B377, (nl,), scalar_order=R377)
+
+
+def encode_scalars_377(values):
+    """Python ints -> (n, 16) standard-form u32 limbs mod r377."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from .constants import to_limbs
+
+    out = np.array(
+        [to_limbs(int(v) % R377) for v in values], dtype=np.uint32
+    )
+    return jnp.asarray(out)

@@ -26,8 +26,8 @@ from .constants import LIMB_BITS, LIMB_MASK, MONT_BITS, N_LIMBS, Q, R, to_limbs
 _MASK = np.uint32(LIMB_MASK)
 
 
-def _limbs_np(x: int) -> np.ndarray:
-    return np.array(to_limbs(x), dtype=np.uint32)
+def _limbs_np(x: int, n_limbs: int = N_LIMBS) -> np.ndarray:
+    return np.array(to_limbs(x, n_limbs), dtype=np.uint32)
 
 
 class PrimeField:
@@ -37,17 +37,25 @@ class PrimeField:
     canonical (< p) Montgomery-form values, unless noted otherwise.
     """
 
-    def __init__(self, modulus: int):
+    def __init__(self, modulus: int, n_limbs: int | None = None):
+        # limb count: 16 for <=256-bit moduli (BN254), 24 for 377/381-bit
+        # curves (BLS12-377/381). Montgomery radix follows: 2^(16 * nl).
+        # Redundancy invariant 4p < 2^(16*nl) must hold for lazy-carry CIOS.
+        self.nl = n_limbs or max(
+            N_LIMBS, -(-(modulus.bit_length() + 2) // LIMB_BITS)
+        )
+        assert 4 * modulus < 1 << (LIMB_BITS * self.nl)
         self.p = modulus
-        self.mont_r = (1 << MONT_BITS) % modulus
+        self.mont_bits = LIMB_BITS * self.nl
+        self.mont_r = (1 << self.mont_bits) % modulus
         self.mont_r2 = self.mont_r * self.mont_r % modulus
         self.mont_rinv = pow(self.mont_r, modulus - 2, modulus)
         # -p^{-1} mod 2^16 for the CIOS reduction step
         self.n0 = np.uint32((-pow(modulus, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS))
-        self.p_limbs = _limbs_np(modulus)
-        self.one = _limbs_np(self.mont_r)  # 1 in Montgomery form
-        self.zero = np.zeros(N_LIMBS, dtype=np.uint32)
-        self.r2 = _limbs_np(self.mont_r2)
+        self.p_limbs = _limbs_np(modulus, self.nl)
+        self.one = _limbs_np(self.mont_r, self.nl)  # 1 in Montgomery form
+        self.zero = np.zeros(self.nl, dtype=np.uint32)
+        self.r2 = _limbs_np(self.mont_r2, self.nl)
         # exponent bits for Fermat inversion, LSB first
         e = modulus - 2
         self._inv_bits = np.array(
@@ -65,29 +73,31 @@ class PrimeField:
         """Python ints / nested lists -> Montgomery limb array (host-side)."""
         arr = np.asarray(values, dtype=object)
         p, r = self.p, self.mont_r
+        nb = 2 * self.nl
         buf = b"".join(
-            ((int(v) % p) * r % p).to_bytes(32, "little") for v in arr.reshape(-1)
+            ((int(v) % p) * r % p).to_bytes(nb, "little") for v in arr.reshape(-1)
         )
         out = np.frombuffer(buf, dtype="<u2").astype(np.uint32)
-        return jnp.asarray(out.reshape(arr.shape + (N_LIMBS,)))
+        return jnp.asarray(out.reshape(arr.shape + (self.nl,)))
 
     def decode(self, x) -> np.ndarray:
         """Montgomery limb array -> numpy object array of Python ints."""
         arr = np.asarray(x)
-        flat = arr.reshape(-1, N_LIMBS).astype("<u2").tobytes()
-        n = arr.size // N_LIMBS
+        nl, nb = self.nl, 2 * self.nl
+        flat = arr.reshape(-1, nl).astype("<u2").tobytes()
+        n = arr.size // nl
         rinv, p = self.mont_rinv, self.p
         out = np.empty(n, dtype=object)
         for i in range(n):
             out[i] = (
-                int.from_bytes(flat[32 * i : 32 * (i + 1)], "little") * rinv % p
+                int.from_bytes(flat[nb * i : nb * (i + 1)], "little") * rinv % p
             )
         return out.reshape(arr.shape[:-1])
 
     def consts(self, shape=()):
         """(zero, one) broadcast to the given batch shape."""
-        z = jnp.broadcast_to(jnp.asarray(self.zero), shape + (N_LIMBS,))
-        o = jnp.broadcast_to(jnp.asarray(self.one), shape + (N_LIMBS,))
+        z = jnp.broadcast_to(jnp.asarray(self.zero), shape + (self.nl,))
+        o = jnp.broadcast_to(jnp.asarray(self.one), shape + (self.nl,))
         return z, o
 
     # -- carry machinery ------------------------------------------------------
@@ -171,7 +181,7 @@ class PrimeField:
         # limb-major layout inside the kernel: (limb,) + batch
         at = jnp.moveaxis(jnp.broadcast_to(a, shape), -1, 0)
         bt = jnp.moveaxis(jnp.broadcast_to(b, shape), -1, 0)
-        qt = jnp.asarray(self.p_limbs).reshape((N_LIMBS,) + (1,) * len(batch))
+        qt = jnp.asarray(self.p_limbs).reshape((self.nl,) + (1,) * len(batch))
         pad_lo = [(0, 1)] + [(0, 0)] * len(batch)
         pad_hi = [(1, 0)] + [(0, 0)] * len(batch)
         zeros_head = jnp.zeros((1,) + batch, jnp.uint32)
@@ -190,8 +200,8 @@ class PrimeField:
                 None,
             )
 
-        v, _ = jax.lax.scan(step, jnp.zeros((N_LIMBS + 1,) + batch, jnp.uint32), at)
-        v = jnp.moveaxis(self._carry_propagate_limb_major(v)[:N_LIMBS], 0, -1)
+        v, _ = jax.lax.scan(step, jnp.zeros((self.nl + 1,) + batch, jnp.uint32), at)
+        v = jnp.moveaxis(self._carry_propagate_limb_major(v)[: self.nl], 0, -1)
         return self._sub_p_if_geq(v)
 
     def sqr(self, a):
@@ -203,7 +213,7 @@ class PrimeField:
 
     def from_mont(self, a_mont):
         """Montgomery form -> standard-form limbs (device-side)."""
-        one_std = jnp.zeros(N_LIMBS, jnp.uint32).at[0].set(1)
+        one_std = jnp.zeros(self.nl, jnp.uint32).at[0].set(1)
         return self.mul(a_mont, jnp.broadcast_to(one_std, a_mont.shape))
 
     # -- predicates -----------------------------------------------------------

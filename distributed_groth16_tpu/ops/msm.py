@@ -85,6 +85,23 @@ def _msm_jit(curve: CurvePoints, points, scalars, c: int):
     return jax.lax.fori_loop(0, W, body, inf)
 
 
+# below this point count the one-ladder MSM wins on compile time (2 curve-op
+# instantiations vs ~10 for a Pippenger window body — each instance costs
+# seconds of XLA:CPU compile) and its 256·n runtime is negligible anyway
+_LADDER_MSM_MAX_N = 128
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _msm_ladder_jit(curve: CurvePoints, points, scalars):
+    """Small-n MSM as one batched double-and-add ladder + a sequential
+    accumulation: the compile-light path (1 add + 1 double + 1 acc-add
+    instantiation). Same results as _msm_jit."""
+    from .curve import scalar_bits
+
+    acc = curve.scalar_mul_bits(points, scalar_bits(scalars))
+    return curve.sum_sequential(acc, axis=0)
+
+
 def _tree_path_ok(curve: CurvePoints, n: int) -> bool:
     """Route G1 MSMs to the limb-major tree path (ops/limb_kernels.py) on
     TPU backends — the Pallas fast path — or anywhere when forced via
@@ -120,6 +137,8 @@ def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
         from .limb_kernels import msm_tree
 
         return msm_tree(points, scalars)
+    if window_bits is None and chunk is None and n <= _LADDER_MSM_MAX_N:
+        return _msm_ladder_jit(curve, points, scalars)
     if window_bits is None:
         # the sort+scan bucketing costs ~n log n adds per window, so fewer,
         # wider windows win once n dwarfs the 2^c bucket-combine cost

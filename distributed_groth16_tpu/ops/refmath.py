@@ -188,10 +188,12 @@ FQ2_ONE = (1, 0)
 
 
 class _CurveOps:
-    def __init__(self, add, sub, mul, sq, neg, inv, scalar, zero, one, b):
+    def __init__(self, add, sub, mul, sq, neg, inv, scalar, zero, one, b,
+                 order=None):
         self.fadd, self.fsub, self.fmul, self.fsq = add, sub, mul, sq
         self.fneg, self.finv, self.fscalar = neg, inv, scalar
         self.zero, self.one, self.b = zero, one, b
+        self.order = order if order is not None else R  # scalar group order
 
     def is_on_curve(self, p) -> bool:
         if p is None:
@@ -234,7 +236,7 @@ class _CurveOps:
         return (p[0], self.fneg(p[1]))
 
     def scalar_mul(self, p, k: int):
-        k %= R
+        k %= self.order
         acc, base = None, p
         while k:
             if k & 1:

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import functools
 
+import jax
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -39,9 +41,9 @@ def fixed_scalar_mul(curve: CurvePoints, pts, tensors):
     for the same lane count n. Returns the same shape:
     out[..., j] = s_j * pts[..., j].
     """
-    import jax
-
     bits, signs, nbits = tensors
+    bits = jnp.asarray(bits)  # cache holds host arrays (tracer hygiene)
+    signs = None if signs is None else jnp.asarray(signs)
     ax = pts.ndim - 2 - curve.coord_axes  # lane axis
     batch = pts.shape[:ax]
     base = jnp.expand_dims(pts, ax)  # (..., 1, n) + point
@@ -108,18 +110,28 @@ class PointDomain:
         cache = curve.__dict__.setdefault("_pntt_cache", {})
         key = (self.size, self.offset, inverse)
         if key not in cache:
-            stages = [
-                fixed_scalar_ladder_tensors(
-                    curve, self._stage_scalars(s, inverse)
+            # eval fence + host materialisation: first use may be inside a
+            # jit/shard_map trace, and cached tracers would poison later
+            # callers (same hazard as pss._ladder_tensors)
+            def host(t):
+                bits, signs, nbits = t
+                return (jax.device_get(bits),
+                        None if signs is None else jax.device_get(signs),
+                        nbits)
+
+            with jax.ensure_compile_time_eval():
+                stages = [
+                    host(fixed_scalar_ladder_tensors(
+                        curve, self._stage_scalars(s, inverse)
+                    ))
+                    for s in range(self.logn)
+                ]
+                scale = self._lane_scale(inverse)
+                scale_t = (
+                    host(fixed_scalar_ladder_tensors(curve, scale))
+                    if scale is not None
+                    else None
                 )
-                for s in range(self.logn)
-            ]
-            scale = self._lane_scale(inverse)
-            scale_t = (
-                fixed_scalar_ladder_tensors(curve, scale)
-                if scale is not None
-                else None
-            )
             cache[key] = (stages, scale_t)
         return cache[key]
 

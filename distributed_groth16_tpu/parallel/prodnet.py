@@ -40,6 +40,13 @@ from .net import CHANNELS, BaseNet, MpcNetError
 
 SYN, SYNACK, DATA = 0, 1, 2
 
+# Frame-length ceiling: a hostile/corrupt peer must not be able to demand a
+# 4 GB allocation with one u32 header (the reference bounds frames the same
+# way via LengthDelimitedCodec::max_frame_length, mpc-net/src/multi.rs:26-33).
+# 256 MiB comfortably clears the largest legitimate share block at million
+# scale (2^20 Fr elements = 32 MiB) while bounding the damage.
+MAX_FRAME_LEN = 256 << 20
+
 
 class StreamIO:
     """asyncio stream pair (TCP or TLS) behind the minimal IO interface."""
@@ -91,12 +98,22 @@ class ChannelIO:
 
 
 async def _send_frame(io, packet_type: int, sid: int, payload: bytes) -> None:
+    if len(payload) + 2 > MAX_FRAME_LEN:
+        raise ValueError(
+            f"frame of {len(payload)} bytes exceeds MAX_FRAME_LEN; "
+            "chunk the payload"
+        )
     env = struct.pack("!IBB", len(payload) + 2, packet_type, sid)
     await io.write(env + payload)
 
 
 async def _recv_frame(io) -> tuple[int, int, bytes]:
     (length,) = struct.unpack("!I", await io.read_exactly(4))
+    if length < 2 or length > MAX_FRAME_LEN:
+        raise ConnectionError(
+            f"bad frame length {length} (cap {MAX_FRAME_LEN}); "
+            "stream corrupt or peer hostile"
+        )
     body = await io.read_exactly(length)
     return body[0], body[1], body[2:]
 

@@ -1,0 +1,40 @@
+"""Persistent-compile-cache configuration shared by every entry point
+(tests/conftest.py, bench.py, __graft_entry__.py).
+
+XLA:CPU AOT cache artifacts are machine-feature-specific: loading an entry
+compiled on a host with different vector extensions warns about feature
+mismatch and can SIGILL. Driver rounds run on heterogeneous hosts, so the
+cache directory is partitioned by a CPU-feature fingerprint.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+
+def machine_tag() -> str:
+    """CPU-feature fingerprint used as the compile-cache partition key."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha1(line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    import platform
+
+    return hashlib.sha1(platform.processor().encode()).hexdigest()[:12]
+
+
+def setup_compile_cache(jax, root: str) -> str:
+    """Point jax's persistent compilation cache at root/<machine_tag>.
+
+    `jax.config.update` works after import as long as no backend has
+    initialized. Returns the cache directory used.
+    """
+    path = os.path.join(root, ".jax_cache", machine_tag())
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path

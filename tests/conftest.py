@@ -21,41 +21,19 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import hashlib  # noqa: E402
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 import jax  # noqa: E402
 
+from distributed_groth16_tpu.utils.cache import setup_compile_cache  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
 
-
-def _machine_tag() -> str:
-    """CPU-feature fingerprint for the compile-cache key: XLA:CPU AOT
-    artifacts are machine-feature-specific, and loading an entry compiled
-    on a host with different AVX512 features segfaults (cpu_aot_loader
-    warns, then SIGILL). Driver rounds may run on heterogeneous hosts, so
-    the cache is partitioned per fingerprint."""
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith("flags"):
-                    return hashlib.sha1(line.encode()).hexdigest()[:12]
-    except OSError:
-        pass
-    import platform
-
-    return hashlib.sha1(platform.processor().encode()).hexdigest()[:12]
-
-
 # Persistent compilation cache: kernel compiles (the dominant test cost) are
-# paid once per machine, not once per pytest run.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "..",
-        ".jax_cache",
-        _machine_tag(),
-    ),
+# paid once per machine, not once per pytest run. Partitioned per CPU
+# fingerprint (utils/cache.py) — foreign AOT entries SIGILL.
+setup_compile_cache(
+    jax, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 )
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -203,3 +203,31 @@ def test_d_fft_over_prod_transport():
     outs = asyncio.run(run())
     got = [int(v) for v in F.decode(unpack_shares(pp, jnp.stack(outs, 0)))]
     assert got == expected
+
+
+def test_frame_length_cap():
+    """A hostile length header must raise, not allocate (the reference caps
+    frames via LengthDelimitedCodec, mpc-net/src/multi.rs:26-33)."""
+    import struct
+
+    from distributed_groth16_tpu.parallel.prodnet import (
+        MAX_FRAME_LEN,
+        _recv_frame,
+        _send_frame,
+    )
+
+    async def run():
+        a, b = ChannelIO.pair()
+        # oversized header from a hostile peer
+        await a.write(struct.pack("!I", MAX_FRAME_LEN + 1))
+        with pytest.raises(ConnectionError):
+            await _recv_frame(b)
+        # oversized send is refused locally
+        with pytest.raises(ValueError):
+            await _send_frame(a, 2, 0, b"x" * (MAX_FRAME_LEN + 1))
+        # a legitimate frame still round-trips
+        await _send_frame(a, 2, 3, b"payload")
+        typ, sid, payload = await _recv_frame(b)
+        assert (typ, sid, payload) == (2, 3, b"payload")
+
+    asyncio.run(run())
