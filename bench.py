@@ -29,8 +29,7 @@ import sys
 import time
 import traceback
 
-LOG2N = 16
-N_POINTS = 1 << LOG2N
+LOG2N = 16  # headline size (2^16); a 2^20 point is also measured
 ARKWORKS_CPU_MSM_PER_SEC = 1.0e6  # documented ballpark, see module docstring
 
 
@@ -95,41 +94,51 @@ def main() -> None:
     from distributed_groth16_tpu.ops.limb_kernels import _msm_tree_jit
     from distributed_groth16_tpu.ops.msm import encode_scalars_std
 
-    rng = np.random.default_rng(0)
-    scalars = encode_scalars_std(
-        [int.from_bytes(rng.bytes(40), "little") % R for _ in range(N_POINTS)]
-    )
-    points = jnp.broadcast_to(
-        g1().encode([G1_GENERATOR])[0], (N_POINTS, 3, 16)
-    )
     inner = _msm_tree_jit.__wrapped__
+    rng = np.random.default_rng(0)
 
-    def make(k: int):
-        @jax.jit
-        def run(points, scalars):
-            acc = jnp.uint32(0)
-            for i in range(k):
-                sc = scalars ^ jnp.uint32(i)  # distinct work per iteration
-                out = inner(points, sc, 8, None)
-                acc = acc + out.sum(dtype=jnp.uint32)
-            return acc
+    def measure(log2n: int) -> tuple[float, float]:
+        """(muls/sec, per-msm seconds) at n = 2^log2n."""
+        n = 1 << log2n
+        scalars = encode_scalars_std(
+            [int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)]
+        )
+        points = jnp.broadcast_to(
+            g1().encode([G1_GENERATOR])[0], (n, 3, 16)
+        )
 
-        return run
+        def make(k: int):
+            @jax.jit
+            def run(points, scalars):
+                acc = jnp.uint32(0)
+                for i in range(k):
+                    sc = scalars ^ jnp.uint32(i)  # distinct work per iter
+                    out = inner(points, sc, 8, None)
+                    acc = acc + out.sum(dtype=jnp.uint32)
+                return acc
 
-    def timed(k: int, reps: int = 4) -> float:
-        fn = make(k)
-        _ = np.asarray(fn(points, scalars))  # compile + warm
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            _ = np.asarray(fn(points, scalars))  # host sync fence
-            best = min(best, time.perf_counter() - t0)
-        return best
+            return run
 
-    t1 = timed(1)
-    t3 = timed(3)
-    per_msm = max((t3 - t1) / 2, 1e-9)
-    muls_per_sec = N_POINTS / per_msm
+        def timed(k: int, reps: int = 4) -> float:
+            fn = make(k)
+            _ = np.asarray(fn(points, scalars))  # compile + warm
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                _ = np.asarray(fn(points, scalars))  # host sync fence
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t1 = timed(1)
+        t3 = timed(3)
+        per_msm = max((t3 - t1) / 2, 1e-9)
+        return n / per_msm, per_msm
+
+    muls_per_sec, per_msm = measure(LOG2N)
+    try:  # BASELINE config 2's size; reported alongside the headline
+        muls_2e20, per_msm_2e20 = measure(20)
+    except Exception:  # memory or tunnel pressure must not kill the bench
+        muls_2e20, per_msm_2e20 = None, None
     print(
         json.dumps(
             {
@@ -139,6 +148,8 @@ def main() -> None:
                 "vs_baseline": round(muls_per_sec / ARKWORKS_CPU_MSM_PER_SEC, 4),
                 "platform": platform,
                 "per_msm_ms": round(per_msm * 1e3, 1),
+                "msm_2e20_per_sec": None if muls_2e20 is None else round(muls_2e20, 1),
+                "msm_2e20_ms": None if per_msm_2e20 is None else round(per_msm_2e20 * 1e3, 1),
                 "method": "marginal (t3-t1)/2, jitted K-loop, host-sync",
             }
         )

@@ -18,13 +18,22 @@ import jax
 
 # Persistent compilation cache: our kernels are built from deep uint32 limb
 # graphs; caching compiled executables across processes matters for tests,
-# benches and the service alike.
-_cache_dir = os.environ.get(
-    "DG16_JAX_CACHE", os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
-)
+# benches and the service alike. Partitioned by CPU fingerprint
+# (utils/cache.py): XLA:CPU AOT entries from a host with different vector
+# features can SIGILL on load, and driver rounds hop between hosts.
 try:
-    jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if "DG16_JAX_CACHE" in os.environ:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.abspath(os.environ["DG16_JAX_CACHE"]),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    else:
+        from .utils.cache import setup_compile_cache
+
+        setup_compile_cache(
+            jax, os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+        )
 except Exception:  # pragma: no cover - older jax without these flags
     pass
 

@@ -30,7 +30,6 @@ import asyncio
 import os
 import time
 
-import jax.numpy as jnp
 from aiohttp import web
 
 from ..frontend.ark_serde import proof_from_bytes, proof_to_bytes

@@ -159,11 +159,11 @@ def read_zkey(path_or_bytes) -> tuple[ProvingKey, R1CS]:
     `c` rows are empty lists. Mirrors ark-circom's read_zkey
     (zkey.rs:53-60).
     """
-    data = (
-        bytes(path_or_bytes)
-        if isinstance(path_or_bytes, (bytes, bytearray))
-        else open(path_or_bytes, "rb").read()
-    )
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
     secs = _parse_sections(data)
 
     # -- header (2) --
@@ -179,7 +179,6 @@ def read_zkey(path_or_bytes) -> tuple[ProvingKey, R1CS]:
     if n8r != 32 or r != R:
         raise ValueError("zkey scalar field is not BN254 Fr")
     n_vars, n_public, domain_size = struct.unpack_from("<III", data, pos + 72)
-    hdr = ZKeyHeader(n_vars, n_public, domain_size)
     vkpos = pos + 84
     # alpha1, beta1, beta2, gamma2, delta1, delta2
     w = [
@@ -213,9 +212,9 @@ def read_zkey(path_or_bytes) -> tuple[ProvingKey, R1CS]:
     l_query = g1_sec(8, n_vars - n_public - 1)
     h_query = g1_sec(9, domain_size)
 
-    from ..ops.curve import g1 as _g1c
+    from ..ops.curve import g1 as _g1curve
 
-    gamma_abc = _g1c().decode(ic)
+    gamma_abc = _g1curve().decode(ic)
     if not isinstance(gamma_abc, list):
         gamma_abc = [gamma_abc]
 
@@ -226,10 +225,8 @@ def read_zkey(path_or_bytes) -> tuple[ProvingKey, R1CS]:
         delta_g2=delta_g2,
         gamma_abc_g1=gamma_abc,
     )
-    from ..ops.curve import g1 as _c1
-
-    beta_g1_d = _c1().encode([beta_g1_h])[0]
-    delta_g1_d = _c1().encode([delta_g1_h])[0]
+    beta_g1_d = _g1curve().encode([beta_g1_h])[0]
+    delta_g1_d = _g1curve().encode([delta_g1_h])[0]
     pk = ProvingKey(
         vk=vk,
         beta_g1=beta_g1_d,
@@ -328,9 +325,9 @@ def write_zkey(pk: ProvingKey, r1cs: R1CS) -> bytes:
         entries += 1
     coefs_payload = struct.pack("<I", entries) + coefs.getvalue()
 
-    from ..ops.curve import g1 as _c1
+    from ..ops.curve import g1 as _g1curve
 
-    ic_dev = _c1().encode(vk.gamma_abc_g1)
+    ic_dev = _g1curve().encode(vk.gamma_abc_g1)
 
     sections = [
         (1, struct.pack("<I", 1)),

@@ -24,8 +24,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...ops import refmath as rm
-from ...ops.constants import G1_GENERATOR, G2_GENERATOR, R
-from ...ops.curve import g1, g2, scalar_bits
+from ...ops.constants import R
+from ...ops.curve import g1, g2
 from ...ops.field import fr
 from ...ops.msm import encode_scalars_std
 from ...ops.ntt import domain

@@ -28,7 +28,6 @@ from __future__ import annotations
 import functools
 
 from . import refmath as rm
-from .constants import LIMB_BITS
 
 # --------------------------------------------------------------------------
 # parameter derivation from the seed

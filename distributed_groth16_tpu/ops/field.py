@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .constants import LIMB_BITS, LIMB_MASK, MONT_BITS, N_LIMBS, Q, R, to_limbs
+from .constants import LIMB_BITS, LIMB_MASK, N_LIMBS, Q, R, to_limbs
 
 _MASK = np.uint32(LIMB_MASK)
 

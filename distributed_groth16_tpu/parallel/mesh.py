@@ -23,8 +23,6 @@ the async star backend over real transport instead.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -47,7 +45,6 @@ except ImportError:  # pragma: no cover
             check_rep=False,
         )
 
-from ..ops.curve import g1, g2
 from ..ops.field import fr
 from ..ops.msm import msm
 from ..ops.ntt import domain
@@ -118,9 +115,7 @@ def _mesh_dmsm_batched(curve, bases_block, scalar_block, pp: PackedSharingParams
     (VERDICT r2 weak #3), so the prover's three same-length G1 MSMs share
     one ladder instead of instantiating three.
     """
-    from ..ops.constants import N_LIMBS
     from ..ops.curve import scalar_bits
-    from ..ops.limb_kernels import use_pallas
 
     F = fr()
     std = F.from_mont(scalar_block[0])  # (B, c, 16)

@@ -4,6 +4,10 @@ plain local MSM, swept over sizes 2^10..2^19 (reference loop,
 dmsm_bench.rs:42-50).
 
 Run: python examples/dmsm_bench.py [--min 10] [--max 19] [--l 2]
+     python examples/dmsm_bench.py --curve bls12-377 --local-only
+(The reference's dmsm_bench runs over BLS12-377 — dmsm_bench.rs:1,48;
+--curve bls12-377 benches the local MSM on that curve. The distributed
+path's PSS domains are BN254-Fr, so d_msm stays BN254 for now.)
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ def main() -> int:
     p.add_argument("--max", type=int, default=19)
     p.add_argument("--l", type=int, default=2)
     p.add_argument("--local-only", action="store_true")
+    p.add_argument("--curve", choices=("bn254", "bls12-377"), default="bn254")
     args = p.parse_args()
 
     import jax
@@ -37,20 +42,34 @@ def main() -> int:
     from distributed_groth16_tpu.parallel.packing import pack_consecutive
     from distributed_groth16_tpu.parallel.pss import PackedSharingParams
 
-    C = g1()
+    if args.curve == "bls12-377":
+        from distributed_groth16_tpu.ops.bls12_377 import (
+            R377,
+            encode_scalars_377,
+            g1_377,
+            g1_generator_377,
+        )
+
+        assert args.local_only, "--curve bls12-377 supports --local-only"
+        C, gen, r_mod = g1_377(), g1_generator_377(), R377
+        enc = encode_scalars_377
+    else:
+        C, gen, r_mod = g1(), G1_GENERATOR, R
+        enc = encode_scalars_std
     F = fr()
     pp = PackedSharingParams(args.l)
     rng = np.random.default_rng(0)
+    nl = C.elem_shape[0]
 
     for logn in range(args.min, args.max + 1):
         n = 1 << logn
         scalars_int = [
-            int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)
+            int.from_bytes(rng.bytes(40), "little") % r_mod for _ in range(n)
         ]
-        points = jnp.broadcast_to(C.encode([G1_GENERATOR])[0], (n, 3, 16))
+        points = jnp.broadcast_to(C.encode([gen])[0], (n, 3, nl))
 
         # local MSM (msm_bench.rs role)
-        std = encode_scalars_std(scalars_int)
+        std = enc(scalars_int)
         out = msm(C, points, std)
         jax.block_until_ready(out)
         t0 = time.perf_counter()

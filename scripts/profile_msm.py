@@ -1,0 +1,135 @@
+"""Piece-wise timing of the tree MSM on the real chip: which stage owns the
+per-MSM milliseconds (sort+gather / up-sweep / Fenwick+combine / Horner)?
+
+Run on an idle machine (single TPU process):  python scripts/profile_msm.py
+Prints one line per variant using the same marginal-cost methodology as
+bench.py (jitted K-loop, host-sync fence).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+
+from distributed_groth16_tpu.utils.cache import setup_compile_cache
+
+setup_compile_cache(
+    jax, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
+from distributed_groth16_tpu.ops.curve import g1
+from distributed_groth16_tpu.ops import limb_kernels as lk
+from distributed_groth16_tpu.ops.msm import encode_scalars_std
+
+LOG2N = int(os.environ.get("PROF_LOG2N", "16"))
+N = 1 << LOG2N
+C = 8
+
+
+def marginal(make_fn, *args, reps: int = 3) -> float:
+    def timed(k):
+        fn = make_fn(k)
+        _ = np.asarray(fn(*args))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _ = np.asarray(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t3 = timed(1), timed(3)
+    return max((t3 - t1) / 2, 1e-9)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    scalars = encode_scalars_std(
+        [int.from_bytes(rng.bytes(40), "little") % R for _ in range(N)]
+    )
+    points = jnp.broadcast_to(g1().encode([G1_GENERATOR])[0], (N, 3, 16))
+    g = lk.lg1()
+    W = 256 // C
+
+    def var_full(k):
+        @jax.jit
+        def run(points, scalars):
+            acc = jnp.uint32(0)
+            for i in range(k):
+                acc += lk._msm_tree_jit.__wrapped__(
+                    points, scalars ^ jnp.uint32(i), C, None
+                ).sum(dtype=jnp.uint32)
+            return acc
+
+        return run
+
+    def var_sort_gather(k):
+        @jax.jit
+        def run(points, scalars):
+            lm = g.from_rowmajor(points)
+            acc = jnp.uint32(0)
+            for i in range(k):
+                digits = lk._digits(scalars ^ jnp.uint32(i), C)  # (W, n)
+                order = jnp.argsort(digits, axis=-1)
+                gathered = jnp.take(lm, order.reshape(-1), axis=1)
+                acc += gathered.sum(dtype=jnp.uint32)
+            return acc
+
+        return run
+
+    def var_sort_only(k):
+        @jax.jit
+        def run(points, scalars):
+            acc = jnp.uint32(0)
+            for i in range(k):
+                digits = lk._digits(scalars ^ jnp.uint32(i), C)
+                order = jnp.argsort(digits, axis=-1)
+                acc += order.sum(dtype=jnp.int32).astype(jnp.uint32)
+            return acc
+
+        return run
+
+    def var_upsweep(k):
+        # up-sweep only: tree adds over (48, W, n) without Fenwick/combine
+        @jax.jit
+        def run(points, scalars):
+            lm = g.from_rowmajor(points)
+            acc = jnp.uint32(0)
+            for i in range(k):
+                digits = lk._digits(scalars ^ jnp.uint32(i), C)
+                order = jnp.argsort(digits, axis=-1)
+                gathered = jnp.take(lm, order.reshape(-1), axis=1).reshape(
+                    48, W, N
+                )
+                x = gathered
+                while x.shape[-1] > 1:
+                    half = x.shape[-1] // 2
+                    pair = x.reshape(48, W, half, 2)
+                    x = g.add(pair[..., 0], pair[..., 1])
+                acc += x.sum(dtype=jnp.uint32)
+            return acc
+
+        return run
+
+    full = marginal(var_full, points, scalars)
+    sort_only = marginal(var_sort_only, points, scalars)
+    sort_gather = marginal(var_sort_gather, points, scalars)
+    upsweep = marginal(var_upsweep, points, scalars)
+    print(f"n=2^{LOG2N} c={C}  (per-MSM marginal seconds)")
+    print(f"full tree msm      : {full*1e3:9.1f} ms  ({N/full:,.0f} muls/s)")
+    print(f"sort only          : {sort_only*1e3:9.1f} ms")
+    print(f"sort+gather        : {sort_gather*1e3:9.1f} ms")
+    print(f"sort+gather+upsweep: {upsweep*1e3:9.1f} ms")
+    print(f"=> fenwick+combine+horner ≈ {(full-upsweep)*1e3:9.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
