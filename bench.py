@@ -27,6 +27,7 @@ import json
 import os
 import sys
 import time
+
 import traceback
 
 LOG2N = 16  # headline size (2^16); a 2^20 point is also measured
@@ -94,6 +95,8 @@ def main() -> None:
     from distributed_groth16_tpu.ops.limb_kernels import _msm_tree_jit
     from distributed_groth16_tpu.ops.msm import encode_scalars_std
 
+    from distributed_groth16_tpu.utils.benchtools import marginal_cost
+
     inner = _msm_tree_jit.__wrapped__
     rng = np.random.default_rng(0)
 
@@ -119,19 +122,7 @@ def main() -> None:
 
             return run
 
-        def timed(k: int, reps: int = 4) -> float:
-            fn = make(k)
-            _ = np.asarray(fn(points, scalars))  # compile + warm
-            best = float("inf")
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                _ = np.asarray(fn(points, scalars))  # host sync fence
-                best = min(best, time.perf_counter() - t0)
-            return best
-
-        t1 = timed(1)
-        t3 = timed(3)
-        per_msm = max((t3 - t1) / 2, 1e-9)
+        per_msm = marginal_cost(make, (points, scalars))
         return n / per_msm, per_msm
 
     muls_per_sec, per_msm = measure(LOG2N)

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import io
 import struct
-from dataclasses import dataclass
 
 import jax.numpy as jnp
 import numpy as np
@@ -126,13 +125,6 @@ def _host_g2(coords: list[int]):
 # ---------------------------------------------------------------------------
 # container
 # ---------------------------------------------------------------------------
-
-
-@dataclass
-class ZKeyHeader:
-    n_vars: int
-    n_public: int  # WITHOUT the constant-1 wire (snarkjs convention)
-    domain_size: int
 
 
 def _parse_sections(data: bytes) -> dict[int, tuple[int, int]]:

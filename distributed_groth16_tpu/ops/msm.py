@@ -154,6 +154,31 @@ def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
     return acc
 
 
+def msm_batched(curve: CurvePoints, bases, scalars_std):
+    """B same-length MSMs: (B, n, 3)+elem x (B, n, 16) std-form scalars ->
+    (B, 3)+elem. Single routing point shared with msm() (incl. the
+    DG16_FORCE_TREE_MSM override): Pallas tree kernels per MSM on TPU G1,
+    one batched ladder at small n, ONE vmapped Pippenger otherwise (a
+    Python loop of Pippengers put B bodies in the traced graph and the
+    m=4096 mesh-prover compile took 13+ minutes)."""
+    B, n = scalars_std.shape[0], scalars_std.shape[1]
+    if _tree_path_ok(curve, n) and n >= 1024:
+        from .limb_kernels import msm_tree
+
+        return jnp.stack(
+            [msm_tree(bases[b], scalars_std[b]) for b in range(B)]
+        )
+    if n <= _LADDER_MSM_MAX_N:
+        from .curve import scalar_bits
+
+        acc = curve.scalar_mul_bits(bases, scalar_bits(scalars_std))
+        return curve.sum_sequential(acc, axis=1)
+    wbits = 16 if n >= (1 << 14) else 8 if n >= 64 else 4
+    return jax.vmap(lambda bs, sc: _msm_jit(curve, bs, sc, wbits))(
+        bases, scalars_std
+    )
+
+
 def msm_g1(points, scalars, **kw):
     return msm(g1(), points, scalars, **kw)
 

@@ -26,7 +26,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 try:  # jax>=0.4.35 moved shard_map out of experimental
     from jax import shard_map as _shard_map_fn
@@ -46,8 +46,6 @@ except ImportError:  # pragma: no cover
         )
 
 from ..ops.field import fr
-from ..ops.msm import msm
-from ..ops.ntt import domain
 from .dfft import _fft1_local, _king_clear_array, _king_tail_array
 from .pss import PackedSharingParams
 
@@ -115,23 +113,11 @@ def _mesh_dmsm_batched(curve, bases_block, scalar_block, pp: PackedSharingParams
     (VERDICT r2 weak #3), so the prover's three same-length G1 MSMs share
     one ladder instead of instantiating three.
     """
-    from ..ops.curve import scalar_bits
+    from ..ops.msm import msm_batched
 
     F = fr()
     std = F.from_mont(scalar_block[0])  # (B, c, 16)
-    B, c = std.shape[0], std.shape[1]
-    if c >= 1024:
-        # real-scale hot path: per-MSM Pippenger via msm() — the Pallas
-        # tree kernels on TPU G1 (ops/limb_kernels), generic windowed
-        # Pippenger elsewhere (incl. G2). The batched ladder below would
-        # cost ~512 curve ops per lane at this size.
-        local = jnp.stack(
-            [msm(curve, bases_block[0][b], std[b]) for b in range(B)]
-        )
-    else:
-        # small-c compile-light path: one batched double-and-add ladder
-        acc = curve.scalar_mul_bits(bases_block[0], scalar_bits(std))
-        local = curve.sum_sequential(acc, axis=1)  # (B,)+point
+    local = msm_batched(curve, bases_block[0], std)  # (B,)+point
     allg = jax.lax.all_gather(local, AXIS, axis=0, tiled=False)  # (n, B)+pt
     allg = jnp.moveaxis(allg, 0, 1)  # (B, n)+pt
     partials = pp.unpackexp(curve, allg, degree2=True)  # (B, l)+pt

@@ -50,7 +50,8 @@ def main() -> int:
             g1_generator_377,
         )
 
-        assert args.local_only, "--curve bls12-377 supports --local-only"
+        if not args.local_only:
+            p.error("--curve bls12-377 requires --local-only")
         C, gen, r_mod = g1_377(), g1_generator_377(), R377
         enc = encode_scalars_377
     else:

@@ -22,6 +22,8 @@ def main() -> int:
     p.add_argument("--log2-constraints", type=int, default=20)
     p.add_argument("--l", type=int, default=2)
     p.add_argument("--x0", type=int, default=999992)
+    p.add_argument("--skip-mpc", action="store_true",
+                   help="setup + single-node prove only (CPU-feasible at 2^20)")
     args = p.parse_args()
 
     from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
@@ -54,6 +56,19 @@ def main() -> int:
     F = fr()
     z_mont = F.encode(z)
     comp = CompiledR1CS(r1cs)
+
+    if args.skip_mpc:
+        from distributed_groth16_tpu.models.groth16.prove import prove_single
+
+        with phase("single-node prove", timings):
+            proof = prove_single(pk, comp, z_mont)
+        ok = verify(pk.vk, proof, z[1 : r1cs.num_instance])
+        print(f"single-node proof verifies: {ok}")
+        print("phase timings (ms):")
+        for k, v in timings.as_millis().items():
+            print(f"  {k:30s} {v:12.1f}")
+        return 0 if ok else 1
+
     pp = PackedSharingParams(args.l)
 
     with phase("packing", timings):

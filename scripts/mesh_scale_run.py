@@ -92,8 +92,8 @@ def main() -> int:
             qap_a=stack(lambda i: qap_shares[i].a),
             qap_b=stack(lambda i: qap_shares[i].b),
             qap_c=stack(lambda i: qap_shares[i].c),
-            a_share=stack(lambda i: a_sh[i]),
-            ax_share=stack(lambda i: ax_sh[i]),
+            a_share=a_sh,
+            ax_share=ax_sh,
             s=stack(lambda i: crs[i].s),
             u=stack(lambda i: crs[i].u),
             v=stack(lambda i: crs[i].v),
@@ -116,10 +116,8 @@ def main() -> int:
         with phase("host-oracle check", timings):
             single = prove_single(pk, comp, z_mont)
             from distributed_groth16_tpu.models.groth16.prove import (
-                reassemble_proof,
-            )
-            from distributed_groth16_tpu.models.groth16.prove import (
                 PartyProofShare,
+                reassemble_proof,
             )
             share = PartyProofShare(a=pa, b=pb, c=pc)
             proof = reassemble_proof(share, pk)

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import os
 import sys
-import time
+
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
@@ -35,19 +35,11 @@ N = 1 << LOG2N
 C = 8
 
 
-def marginal(make_fn, *args, reps: int = 3) -> float:
-    def timed(k):
-        fn = make_fn(k)
-        _ = np.asarray(fn(*args))
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            _ = np.asarray(fn(*args))
-            best = min(best, time.perf_counter() - t0)
-        return best
+from distributed_groth16_tpu.utils.benchtools import marginal_cost
 
-    t1, t3 = timed(1), timed(3)
-    return max((t3 - t1) / 2, 1e-9)
+
+def marginal(make_fn, *args):
+    return marginal_cost(make_fn, args, reps=3)
 
 
 def main():
