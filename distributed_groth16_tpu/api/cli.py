@@ -90,6 +90,19 @@ def cmd_verify(args) -> dict:
     )
 
 
+def cmd_export_eth(args) -> dict:
+    """Local conversion — no server round-trip needed."""
+    from ..frontend.ark_serde import proof_from_bytes
+    from ..frontend.ethereum import proof_to_json, solidity_calldata
+
+    with open(args.proof, "rb") as f:
+        proof = proof_from_bytes(f.read())
+    return {
+        "calldata": json.loads(solidity_calldata(proof, args.public)),
+        "proof_json": proof_to_json(proof),
+    }
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="dg16-cli")
     p.add_argument("--url", default="http://localhost:8000")
@@ -115,8 +128,20 @@ def main(argv=None) -> None:
     sp.add_argument("--public", action="append", default=[], type=int)
     sp.set_defaults(fn=cmd_verify)
 
+    sp = sub.add_parser(
+        "export-eth",
+        help="proof file -> Solidity verifyProof calldata + snarkjs JSON "
+             "(the ethereum.rs role, ark-circom/src/ethereum.rs)",
+    )
+    sp.add_argument("--proof", required=True, help="ark-compressed proof file")
+    sp.add_argument("--public", action="append", default=[], type=int)
+    sp.set_defaults(fn=cmd_export_eth)
+
     args = p.parse_args(argv)
-    print(json.dumps(args.fn(args), indent=2)[:2000])
+    out = json.dumps(args.fn(args), indent=2)
+    # machine-consumed outputs (calldata) must never be truncated; the cap
+    # only trims chatty server-status bodies
+    print(out if args.cmd == "export-eth" else out[:2000])
 
 
 if __name__ == "__main__":

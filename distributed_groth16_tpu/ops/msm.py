@@ -162,7 +162,7 @@ def msm_batched(curve: CurvePoints, bases, scalars_std):
     Python loop of Pippengers put B bodies in the traced graph and the
     m=4096 mesh-prover compile took 13+ minutes)."""
     B, n = scalars_std.shape[0], scalars_std.shape[1]
-    if _tree_path_ok(curve, n) and n >= 1024:
+    if _tree_path_ok(curve, n):
         from .limb_kernels import msm_tree
 
         return jnp.stack(

@@ -1,0 +1,66 @@
+"""Ethereum export (frontend/ethereum.py): round-trip and layout checks
+against a real proof — the ethereum.rs role (ark-circom/src/ethereum.rs)."""
+
+import json
+import os
+
+import pytest
+
+from distributed_groth16_tpu.frontend.ethereum import (
+    inputs_to_eth,
+    proof_from_eth,
+    proof_to_eth,
+    proof_to_json,
+    solidity_calldata,
+    vk_from_eth,
+    vk_to_eth,
+)
+from distributed_groth16_tpu.frontend.readers import read_r1cs
+from distributed_groth16_tpu.frontend.witness_calculator import (
+    WitnessCalculator,
+)
+from distributed_groth16_tpu.models.groth16 import CompiledR1CS, setup, verify
+from distributed_groth16_tpu.models.groth16.prove import prove_single
+from distributed_groth16_tpu.ops.field import fr
+
+TV = "/root/reference/ark-circom/test-vectors"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(f"{TV}/mycircuit.r1cs"), reason="no fixture"
+)
+
+
+def _proof_and_vk():
+    r1cs, _ = read_r1cs(f"{TV}/mycircuit.r1cs")
+    pk = setup(r1cs)
+    z = WitnessCalculator.from_file(f"{TV}/mycircuit.wasm").calculate_witness(
+        {"a": 3, "b": 11}
+    )
+    proof = prove_single(pk, CompiledR1CS(r1cs), fr().encode(z))
+    return proof, pk.vk, z[1:r1cs.num_instance]
+
+
+def test_roundtrip_and_still_verifies():
+    proof, vk, publics = _proof_and_vk()
+    p2 = proof_from_eth(proof_to_eth(proof))
+    v2 = vk_from_eth(vk_to_eth(vk))
+    assert (p2.a, p2.b, p2.c) == (proof.a, proof.b, proof.c)
+    assert v2.gamma_abc_g1 == vk.gamma_abc_g1
+    assert verify(v2, p2, inputs_to_eth(publics))
+
+
+def test_g2_c1_limb_first():
+    """Solidity precompiles take the Fq2 c1 limb first (ethereum.rs:82-85)."""
+    proof, _, _ = _proof_and_vk()
+    (x0, x1), (y0, y1) = proof.b  # native: c0-first
+    b_eth = proof_to_eth(proof)[1]
+    assert b_eth == ((x1, x0), (y1, y0))
+
+
+def test_calldata_and_json_shapes():
+    proof, _, publics = _proof_and_vk()
+    data = json.loads(solidity_calldata(proof, publics))
+    assert len(data) == 4
+    assert all(w.startswith("0x") and len(w) == 66 for w in data[0])
+    pj = proof_to_json(proof)
+    assert pj["protocol"] == "groth16" and len(pj["pi_b"]) == 3
