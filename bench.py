@@ -125,20 +125,33 @@ def main() -> None:
         per_msm = marginal_cost(make, (points, scalars))
         return n / per_msm, per_msm
 
-    muls_per_sec, per_msm = measure(LOG2N)
-    try:  # BASELINE config 2's size; reported alongside the headline
-        muls_2e20, per_msm_2e20 = measure(20)
-    except Exception:  # memory or tunnel pressure must not kill the bench
-        muls_2e20, per_msm_2e20 = None, None
+    # CPU fallback guard: the tree MSM at 2^16/2^20 takes hours on the
+    # XLA:CPU bodies; measure a small size instead so the driver's bench
+    # budget survives a dead tunnel (the JSON carries platform="cpu" so the
+    # number is clearly not the TPU metric).
+    log2n = LOG2N if platform == "tpu" else 12
+    muls_per_sec, per_msm = measure(log2n)
+    muls_2e20, per_msm_2e20 = None, None
+    if platform == "tpu":
+        try:  # BASELINE config 2's size; reported alongside the headline
+            muls_2e20, per_msm_2e20 = measure(20)
+        except Exception:  # memory/tunnel pressure must not kill the bench
+            pass
     print(
         json.dumps(
             {
-                "metric": "msm_g1_scalar_muls_per_sec_2e16",
+                "metric": f"msm_g1_scalar_muls_per_sec_2e{log2n}",
                 "value": round(muls_per_sec, 1),
                 "unit": "scalar-muls/sec",
-                "vs_baseline": round(muls_per_sec / ARKWORKS_CPU_MSM_PER_SEC, 4),
+                # numeric always (driver-parsed); the metric name carries
+                # the measured size, and the denominator stays the 2^16-2^20
+                # arkworks ballpark documented in BASELINE.md
+                "vs_baseline": round(
+                    muls_per_sec / ARKWORKS_CPU_MSM_PER_SEC, 4
+                ),
                 "platform": platform,
                 "per_msm_ms": round(per_msm * 1e3, 1),
+                "measured_log2n": log2n,
                 "msm_2e20_per_sec": None if muls_2e20 is None else round(muls_2e20, 1),
                 "msm_2e20_ms": None if per_msm_2e20 is None else round(per_msm_2e20 * 1e3, 1),
                 "method": "marginal (t3-t1)/2, jitted K-loop, host-sync",
