@@ -21,9 +21,8 @@ from dataclasses import dataclass
 
 import jax.numpy as jnp
 
-from ...ops.curve import CurvePoints, g1, g2, scalar_bits
+from ...ops.curve import CurvePoints, g1, g2
 from ...ops.field import fr
-from ...ops.msm import encode_scalars_std
 from ...parallel.dmsm import d_msm
 from ...parallel.net import Net
 from ...parallel.packing import pack_consecutive
@@ -35,11 +34,21 @@ from .qap import PackedQAPShare
 
 
 def _maybe_mul(curve: CurvePoints, p, k: int):
-    """k * p for a host int k; None point or k == 0 contributes infinity."""
+    """k * p for a host int k; None point or k == 0 contributes infinity.
+
+    Single-point work runs on the HOST (refmath): a 256-step device ladder
+    for one point is pure dispatch overhead, and the eager-dispatch scan it
+    used to emit deterministically crashed this jax's XLA:CPU compiler late
+    in a long-lived process (segfault in backend_compile_and_load after
+    ~dozens of live executables)."""
     if p is None or k % fr().p == 0:
         return None
-    bits = scalar_bits(encode_scalars_std([k]))[0]
-    return curve.scalar_mul_bits(p, bits)
+    from ...ops import refmath as rm
+
+    host = rm.G1 if curve.coord_axes == 1 else rm.G2
+    aff = curve.decode(p)
+    out = host.scalar_mul(aff, k)
+    return curve.encode([out])[0]
 
 
 def _acc(curve: CurvePoints, *pts):

@@ -27,14 +27,30 @@ def machine_tag() -> str:
     return hashlib.sha1(platform.processor().encode()).hexdigest()[:12]
 
 
-def setup_compile_cache(jax, root: str) -> str:
+def setup_compile_cache(
+    jax, root: str, min_compile_seconds: float = 0.5
+) -> str:
     """Point jax's persistent compilation cache at root/<machine_tag>.
 
     `jax.config.update` works after import as long as no backend has
     initialized. Returns the cache directory used.
+
+    min_compile_seconds: caching floor. The test suite passes 5.0 — this
+    jax's XLA:CPU AOT loader deterministically SEGFAULTS deserializing
+    certain small eager-dispatch `scan` executables once enough other
+    executables are live (observed on the ZK prover path after ~46 suite
+    tests; crash inside compilation_cache.get_executable_and_time). Tiny
+    entries recompile in under a second anyway; the floor keeps them out
+    of the cache entirely while the minutes-scale prover/kernel programs
+    stay cached.
     """
-    path = os.path.join(root, ".jax_cache", machine_tag())
+    # v2: versioned partition — pre-v2 partitions were written with a
+    # 0.5s floor and may hold the small scan executables whose AOT load
+    # can also crash; a version bump orphans them wholesale
+    path = os.path.join(root, ".jax_cache", "v2-" + machine_tag())
     jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_seconds
+    )
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return path

@@ -33,7 +33,12 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: kernel compiles (the dominant test cost) are
 # paid once per machine, not once per pytest run. Partitioned per CPU
-# fingerprint (utils/cache.py) — foreign AOT entries SIGILL.
+# fingerprint (utils/cache.py) — foreign AOT entries SIGILL. The 5s floor
+# keeps small eager-scan executables out of the cache: this jax's AOT
+# loader segfaults deserializing some of them late in the suite (see
+# utils/cache.py docstring).
 setup_compile_cache(
-    jax, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    jax,
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."),
+    min_compile_seconds=5.0,
 )
