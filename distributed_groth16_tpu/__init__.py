@@ -22,7 +22,11 @@ import jax
 # (utils/cache.py): XLA:CPU AOT entries from a host with different vector
 # features can SIGILL on load, and driver rounds hop between hosts.
 try:
-    if "DG16_JAX_CACHE" in os.environ:
+    if os.environ.get("DG16_NO_JAX_CACHE"):
+        from .utils.cache import disable_compile_cache
+
+        disable_compile_cache(jax)
+    elif "DG16_JAX_CACHE" in os.environ:
         jax.config.update(
             "jax_compilation_cache_dir",
             os.path.abspath(os.environ["DG16_JAX_CACHE"]),

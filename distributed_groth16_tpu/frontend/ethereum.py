@@ -93,21 +93,11 @@ def inputs_to_eth(values) -> list[int]:
 
 def proof_to_json(proof: Proof) -> dict:
     """snarkjs-compatible proof JSON (pi_a/pi_b/pi_c, projective with
-    z = 1; pi_b rows keep snarkjs' c0-first JSON order)."""
-    a = _g1_tuple(proof.a)
-    c = _g1_tuple(proof.c)
-    b = proof.b if proof.b is not None else ((0, 0), (0, 0))
-    return {
-        "protocol": "groth16",
-        "curve": "bn128",
-        "pi_a": [str(a[0]), str(a[1]), "1"],
-        "pi_b": [
-            [str(b[0][0] % Q), str(b[0][1] % Q)],
-            [str(b[1][0] % Q), str(b[1][1] % Q)],
-            ["1", "0"],
-        ],
-        "pi_c": [str(c[0]), str(c[1]), "1"],
-    }
+    z = 1; pi_b rows keep snarkjs' c0-first JSON order). Delegates to
+    frontend.snarkjs.dump_proof — one emitter for the external format."""
+    from .snarkjs import dump_proof
+
+    return dump_proof(proof)
 
 
 def solidity_calldata(proof: Proof, public_inputs) -> str:
