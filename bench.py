@@ -92,7 +92,7 @@ def main() -> None:
 
     from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
     from distributed_groth16_tpu.ops.curve import g1
-    from distributed_groth16_tpu.ops.limb_kernels import _msm_tree_jit
+    from distributed_groth16_tpu.ops.limb_kernels import _msm_tree_jit, lg1
     from distributed_groth16_tpu.ops.msm import encode_scalars_std
 
     from distributed_groth16_tpu.utils.benchtools import marginal_cost
@@ -116,7 +116,7 @@ def main() -> None:
                 acc = jnp.uint32(0)
                 for i in range(k):
                     sc = scalars ^ jnp.uint32(i)  # distinct work per iter
-                    out = inner(points, sc, 8, None)
+                    out = inner(lg1(), points, sc, 8, None)
                     acc = acc + out.sum(dtype=jnp.uint32)
                 return acc
 
@@ -132,10 +132,34 @@ def main() -> None:
     log2n = LOG2N if platform == "tpu" else 12
     muls_per_sec, per_msm = measure(log2n)
     muls_2e20, per_msm_2e20 = None, None
+    ntt_2e20_ms = None
     if platform == "tpu":
         try:  # BASELINE config 2's size; reported alongside the headline
             muls_2e20, per_msm_2e20 = measure(20)
         except Exception:  # memory/tunnel pressure must not kill the bench
+            pass
+        try:  # BASELINE config 3's kernel: radix-2 NTT over Fr (Pallas
+            # four-step limb path), 2^20 coefficients
+            from distributed_groth16_tpu.ops.ntt_limb import ntt_limb
+
+            n_ntt = 1 << 20
+            x = jnp.asarray(
+                rng.integers(0, 1 << 16, size=(16, n_ntt), dtype=np.uint32)
+            )
+
+            def make_ntt(k: int):
+                @jax.jit
+                def run(x):
+                    acc = jnp.uint32(0)
+                    for i in range(k):
+                        out = ntt_limb(x ^ jnp.uint32(i), n_ntt, False)
+                        acc = acc + out.sum(dtype=jnp.uint32)
+                    return acc
+
+                return run
+
+            ntt_2e20_ms = round(marginal_cost(make_ntt, (x,)) * 1e3, 1)
+        except Exception:
             pass
     print(
         json.dumps(
@@ -154,6 +178,7 @@ def main() -> None:
                 "measured_log2n": log2n,
                 "msm_2e20_per_sec": None if muls_2e20 is None else round(muls_2e20, 1),
                 "msm_2e20_ms": None if per_msm_2e20 is None else round(per_msm_2e20 * 1e3, 1),
+                "ntt_2e20_ms": ntt_2e20_ms,
                 "method": "marginal (t3-t1)/2, jitted K-loop, host-sync",
             }
         )

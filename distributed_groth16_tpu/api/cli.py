@@ -98,7 +98,9 @@ def cmd_export_eth(args) -> dict:
     with open(args.proof, "rb") as f:
         proof = proof_from_bytes(f.read())
     return {
-        "calldata": json.loads(solidity_calldata(proof, args.public)),
+        # the raw generatecall string (bracket-less groups) — paste into
+        # verifyProof tooling as-is
+        "calldata": solidity_calldata(proof, args.public),
         "proof_json": proof_to_json(proof),
     }
 

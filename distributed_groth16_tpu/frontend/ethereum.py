@@ -101,19 +101,23 @@ def proof_to_json(proof: Proof) -> dict:
 
 
 def solidity_calldata(proof: Proof, public_inputs) -> str:
-    """The flat hex calldata string snarkjs' generatecall produces: proof
-    tuples then inputs, each as a 0x-padded 32-byte word."""
+    """The exact string `snarkjs generatecall` emits: four bracketed
+    groups joined by bare commas with NO enclosing outer brackets —
+    `[A.x, A.y],[[B.x.c1, B.x.c0],[B.y.c1, B.y.c0]],[C.x, C.y],[inputs]`
+    — each word a quoted 0x-padded 32-byte hex, a space after the comma
+    inside the 2-element pairs, none between inputs (snarkjs
+    groth16ExportSolidityCallData). Paste-compatible with Remix /
+    verifier tooling expecting generatecall output."""
 
     def word(v: int) -> str:
-        return "0x" + int(v).to_bytes(32, "big").hex()
+        return '"0x' + int(v).to_bytes(32, "big").hex() + '"'
 
     a, b, c = proof_to_eth(proof)
-    words = [
-        [word(a[0]), word(a[1])],
-        [[word(b[0][0]), word(b[0][1])], [word(b[1][0]), word(b[1][1])]],
-        [word(c[0]), word(c[1])],
-        [word(v) for v in inputs_to_eth(public_inputs)],
-    ]
-    import json
-
-    return json.dumps(words)
+    inputs = ",".join(word(v) for v in inputs_to_eth(public_inputs))
+    return (
+        f"[{word(a[0])}, {word(a[1])}],"
+        f"[[{word(b[0][0])}, {word(b[0][1])}],"
+        f"[{word(b[1][0])}, {word(b[1][1])}]],"
+        f"[{word(c[0])}, {word(c[1])}],"
+        f"[{inputs}]"
+    )

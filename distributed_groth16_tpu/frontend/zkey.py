@@ -238,17 +238,27 @@ def read_zkey(path_or_bytes) -> tuple[ProvingKey, R1CS]:
     pos += 4
     rows_a: dict[int, list] = {}
     rows_b: dict[int, list] = {}
-    max_constraint = 0
+    # one vectorized frombuffer over the fixed 44-byte records, then a
+    # single Montgomery-correction pass over the UNIQUE coefficient
+    # patterns (real circuits use a handful — mostly ±1): the per-record
+    # struct.unpack + 256-bit multiply this replaces costs minutes of
+    # Python at million-constraint scale
+    rec = np.dtype(
+        [("m", "<u4"), ("c", "<u4"), ("s", "<u4"), ("v", "V32")]
+    )
+    arr = np.frombuffer(data, dtype=rec, count=n_coeffs, offset=pos)
     rinv2 = _MONT_R_INV * _MONT_R_INV % R
-    for _ in range(n_coeffs):
-        matrix, constraint, signal = struct.unpack_from("<III", data, pos)
-        pos += 12
-        raw = int.from_bytes(data[pos : pos + 32], "little")
-        pos += 32
-        value = raw * rinv2 % R
-        max_constraint = max(max_constraint, constraint)
+    max_constraint = int(arr["c"].max()) if n_coeffs else 0
+    uniq, inv_idx = np.unique(arr["v"], return_inverse=True)
+    uvals = [
+        int.from_bytes(u.tobytes(), "little") * rinv2 % R for u in uniq
+    ]
+    for matrix, constraint, signal, vi in zip(
+        arr["m"].tolist(), arr["c"].tolist(), arr["s"].tolist(),
+        inv_idx.tolist(),
+    ):
         (rows_a if matrix == 0 else rows_b).setdefault(constraint, []).append(
-            (value, signal)
+            (uvals[vi], signal)
         )
     # drop the synthetic public-input rows arkworks re-adds (zkey.rs:173-177)
     num_constraints = max_constraint - n_public

@@ -43,11 +43,20 @@ class MeshProverInputs:
     u: jnp.ndarray  # (n, m/l, 3, 16)
     v: jnp.ndarray  # (n, c_a, 3, 2, 16)
     w: jnp.ndarray  # (n, c_w, 3, 16)
+    h: jnp.ndarray | None = None  # (n, c_a, 3, 16) b_g1_query shares (zk)
 
 
-def build_mesh_prover(pp: PackedSharingParams, m: int, mesh: Mesh):
+def build_mesh_prover(pp: PackedSharingParams, m: int, mesh: Mesh,
+                      zk: bool = False):
     """Returns a jitted SPMD function computing the clear proof cores
-    (pi_a, pi_b, pi_c) from MeshProverInputs."""
+    (pi_a, pi_b, pi_c) from MeshProverInputs.
+
+    zk=True additionally computes the H-query MSM (b_g1_query shares ·
+    a_share) as a 4th row of the batched G1 d_msm and returns it as a 4th
+    output; it feeds the r-weighted C term. The r/s randomization itself is
+    host-side arithmetic on the clear cores (mesh_prove_zk) — the cores are
+    public after the king broadcast, exactly as in the async-star path
+    (prove.rs:10-137 randomizes; sha256.rs:208-212 reassembles clear)."""
     logm = m.bit_length() - 1
     dom = domain(m)
     dom2 = domain(2 * m)
@@ -55,7 +64,7 @@ def build_mesh_prover(pp: PackedSharingParams, m: int, mesh: Mesh):
     wpows_2m = dom2._wpows
     size_inv_m = dom._size_inv
 
-    def step(qa, qb, qc, a_sh, ax_sh, s_q, u_q, v_q, w_q):
+    def step(qa, qb, qc, a_sh, ax_sh, s_q, u_q, v_q, w_q, h_q=None):
         # --- ext_wit::h -------------------------------------------------
         # the a/b/c pipelines are shape-identical: run them as ONE batched
         # transform (leading axis 3) — a third of the traced graph, and the
@@ -91,24 +100,32 @@ def build_mesh_prover(pp: PackedSharingParams, m: int, mesh: Mesh):
             )
             return jnp.concatenate([x, extra], axis=0)
 
-        g1_bases = jnp.stack(
-            [padp(s_q[0]), padp(w_q[0]), padp(u_q[0])], axis=0
-        )[None]
-        g1_scalars = jnp.stack(
-            [pads(a_sh[0]), pads(ax_sh[0]), pads(h_share[0])], axis=0
-        )[None]
-        pa_cw_cu = _mesh_dmsm_batched(g1(), g1_bases, g1_scalars, pp)
-        pi_a, c_w, c_u = pa_cw_cu[0], pa_cw_cu[1], pa_cw_cu[2]
+        g1_bases = [padp(s_q[0]), padp(w_q[0]), padp(u_q[0])]
+        g1_scalars = [pads(a_sh[0]), pads(ax_sh[0]), pads(h_share[0])]
+        if zk:
+            g1_bases.append(padp(h_q[0]))
+            g1_scalars.append(pads(a_sh[0]))
+        out = _mesh_dmsm_batched(
+            g1(),
+            jnp.stack(g1_bases, axis=0)[None],
+            jnp.stack(g1_scalars, axis=0)[None],
+            pp,
+        )
+        pi_a, c_w, c_u = out[0], out[1], out[2]
         pi_b = _mesh_dmsm(g2(), v_q, a_sh, pp)
         pi_c = g1().add(c_w, c_u)
+        if zk:
+            return pi_a[None], pi_b[None], pi_c[None], out[3][None]
         return pi_a[None], pi_b[None], pi_c[None]
 
     sharded = P(AXIS)
+    n_in = 10 if zk else 9
+    n_out = 4 if zk else 3
     mapped = shard_map(
         step,
         mesh,
-        in_specs=(sharded,) * 9,
-        out_specs=(sharded, sharded, sharded),
+        in_specs=(sharded,) * n_in,
+        out_specs=(sharded,) * n_out,
     )
     return jax.jit(mapped)
 
@@ -122,3 +139,50 @@ def mesh_prove(pp, m, mesh, inp: MeshProverInputs):
         inp.s, inp.u, inp.v, inp.w,
     )
     return pa[0], pb[0], pc[0]
+
+
+def mesh_prove_zk(pp, m, mesh, inp: MeshProverInputs, pk, r: int, s: int):
+    """Full zero-knowledge mesh prove: SPMD cores + host r/s randomization.
+
+    Same algebra as the async-star zk path (prove.rs:10-137):
+        A = core_A + (a_query[0] + alpha) + r*delta_g1
+        B = core_B + (b_g2_query[0] + beta)  + s*delta_g2
+        C = core_C + s*A + r*(beta_g1 + b_g1_query[0]) + r*h_msm
+    where core_C = w + u and h_msm = d_msm(b_g1_query[1:] shares, a_share)
+    (the 4th batched MSM row). All completion terms are public CRS values
+    and the cores are clear post-broadcast, so randomization is exact host
+    bigint math — no extra device compile. r = s = 0 degenerates to the
+    deterministic reassembly.
+    """
+    from ...ops import refmath as rm
+    from ...ops.field import fr
+    from .keys import Proof
+
+    p = fr().p
+    r, s = r % p, s % p
+    C1, C2 = g1(), g2()
+    if inp.h is None:
+        raise ValueError("mesh_prove_zk needs MeshProverInputs.h "
+                         "(b_g1_query shares)")
+    prover = build_mesh_prover(pp, m, mesh, zk=True)
+    pa, pb, pc, ph = prover(
+        inp.qap_a, inp.qap_b, inp.qap_c, inp.a_share, inp.ax_share,
+        inp.s, inp.u, inp.v, inp.w, inp.h,
+    )
+    a_core = C1.decode(pa[0])
+    b_core = C2.decode(pb[0])
+    c_core = C1.decode(pc[0])
+    h_msm = C1.decode(ph[0])
+    vk = pk.vk
+    a0 = rm.G1.add(C1.decode(pk.a_query[0]), vk.alpha_g1)
+    b0 = rm.G2.add(C2.decode(pk.b_g2_query[0]), vk.beta_g2)
+    delta_g1 = C1.decode(pk.delta_g1)
+    m_term = rm.G1.add(C1.decode(pk.beta_g1), C1.decode(pk.b_g1_query[0]))
+    a_full = rm.G1.add(rm.G1.add(a_core, a0), rm.G1.scalar_mul(delta_g1, r))
+    b_full = rm.G2.add(rm.G2.add(b_core, b0),
+                       rm.G2.scalar_mul(vk.delta_g2, s))
+    c_full = rm.G1.add(
+        rm.G1.add(c_core, rm.G1.scalar_mul(a_full, s)),
+        rm.G1.scalar_mul(rm.G1.add(m_term, h_msm), r),
+    )
+    return Proof(a=a_full, b=b_full, c=c_full)

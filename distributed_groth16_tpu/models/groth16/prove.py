@@ -44,7 +44,13 @@ def _maybe_mul(curve: CurvePoints, p, k: int):
     if p is None or k % fr().p == 0:
         return None
     from ...ops import refmath as rm
+    from ...ops.constants import Q as _BN254_Q
 
+    # the host ops below are BN254-only; dispatching by coord_axes alone
+    # would silently compute garbage for another curve's points
+    base_p = curve.F.p if curve.coord_axes == 1 else curve.F.fq.p
+    if base_p != _BN254_Q:
+        raise NotImplementedError("_maybe_mul host path is BN254-only")
     host = rm.G1 if curve.coord_axes == 1 else rm.G2
     aff = curve.decode(p)
     out = host.scalar_mul(aff, k)

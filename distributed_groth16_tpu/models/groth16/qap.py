@@ -76,13 +76,21 @@ class SparseMatrixDevice:
     def matvec(self, z: jnp.ndarray) -> jnp.ndarray:
         """(nw, 16) Montgomery assignment -> (num_rows, 16) row inner
         products, all on device."""
-        F = fr()
-        prod = F.mul(self.coeffs, jnp.take(z, self.cols, axis=0))
-        prefix = jax.lax.associative_scan(F.add, prod, axis=0)
-        hi = jnp.take(prefix, self.ends_idx, axis=0)
-        lo = jnp.take(prefix, self.starts_idx, axis=0)
-        val = jnp.where(self.at_origin[:, None], hi, F.sub(hi, lo))
-        return jnp.where(self.nonempty[:, None], val, jnp.zeros_like(val))
+        return _matvec_jit(
+            self.coeffs, self.cols, self.ends_idx, self.starts_idx,
+            self.nonempty, self.at_origin, z,
+        )
+
+
+@jax.jit  # eager associative_scan dispatch is an XLA:CPU crash class
+def _matvec_jit(coeffs, cols, ends_idx, starts_idx, nonempty, at_origin, z):
+    F = fr()
+    prod = F.mul(coeffs, jnp.take(z, cols, axis=0))
+    prefix = jax.lax.associative_scan(F.add, prod, axis=0)
+    hi = jnp.take(prefix, ends_idx, axis=0)
+    lo = jnp.take(prefix, starts_idx, axis=0)
+    val = jnp.where(at_origin[:, None], hi, F.sub(hi, lo))
+    return jnp.where(nonempty[:, None], val, jnp.zeros_like(val))
 
 
 @dataclass

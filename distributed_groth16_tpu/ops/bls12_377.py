@@ -249,3 +249,55 @@ def encode_scalars_377(values):
         [to_limbs(int(v) % R377) for v in values], dtype=np.uint32
     )
     return jnp.asarray(out)
+
+
+# --------------------------------------------------------------------------
+# Packed secret sharing over Fr377 — the reference's BLS12-377 d_msm
+# configuration (dmsm_bench.rs:42-50 packs over BLS12-377 Fr)
+# --------------------------------------------------------------------------
+
+
+@functools.cache
+def pss377(l: int):
+    """PackedSharingParams over the BLS12-377 scalar field.
+
+    The host domains (share/secret/secret2 and the pack/unpack matrices
+    derived from them) are built over r377; the in-the-exponent
+    dense-ladder maps are curve-generic and ride them unchanged. Device
+    FIELD-share transforms raise NotImplementedError (BN254-NTT backed) —
+    scalar-share packing for this curve goes through pack_scalars_377
+    (device mul-adds off the pack matrix)."""
+    from ..parallel.pss import PackedSharingParams
+
+    return PackedSharingParams(l, modulus=R377, generator=_fr_generator())
+
+
+def pack_scalars_377(pp, values):
+    """Pack Fr377 secrets l-at-a-time into n shares, device-side: one
+    (n, l) matrix mul-add over PrimeField(R377) Montgomery tensors.
+
+    values: flat list of ints (length a multiple of l, zero-padded
+    otherwise). Returns (n, c, 16) Montgomery share tensors, c = len/l,
+    CONSECUTIVE chunking: chunk j packs values[j*l : (j+1)*l] (the
+    pack_consecutive convention — pair with identically-chunked
+    packexp_from_public base shares)."""
+    import jax.numpy as jnp
+
+    F = fr377()
+    vals = [int(v) % R377 for v in values]
+    rem = (-len(vals)) % pp.l
+    vals += [0] * rem
+    c = len(vals) // pp.l
+    # chunk j = (vals[j*l], ..., vals[j*l + l-1]) -> secrets of share row
+    chunks = F.encode(vals)  # (c*l, 16)
+    chunks = chunks.reshape(c, pp.l, 16)
+    mat = F.encode([pp.pack_matrix[p][i] for p in range(pp.n)
+                    for i in range(pp.l)]).reshape(pp.n, pp.l, 16)
+    # out[p, j] = sum_i mat[p, i] * chunks[j, i]
+    out = []
+    for p in range(pp.n):
+        acc = F.mul(chunks[:, 0, :], mat[p, 0][None, :])
+        for i in range(1, pp.l):
+            acc = F.add(acc, F.mul(chunks[:, i, :], mat[p, i][None, :]))
+        out.append(acc)
+    return jnp.stack(out, axis=0)  # (n, c, 16)

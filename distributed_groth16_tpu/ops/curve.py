@@ -50,9 +50,17 @@ class CurvePoints:
         # fall back to full-width double-and-add.
         self.glv = glv
         self._beta_c = self._const(glv.beta) if glv is not None else None
-        # jit the big combinational kernels once per instance
+        # jit the big combinational kernels once per instance. The
+        # scan-shaped ones (ladders, sequential sums) MUST be jitted:
+        # eagerly-dispatched scan/fori executables are an XLA:CPU crash
+        # class here (backend_compile_and_load segfault once enough
+        # executables are live in a long-lived process).
         self.add = jax.jit(self.add)
         self.double = jax.jit(self.double)
+        self.scalar_mul_bits = jax.jit(self.scalar_mul_bits)
+        self.sum_sequential = jax.jit(
+            self.sum_sequential, static_argnames=("axis",)
+        )
 
     def _triple_int(self, b):
         p = self.F.p if hasattr(self.F, "p") else self.F.fq.p
@@ -291,19 +299,22 @@ class CurvePoints:
         """
         X, Y, Z = self._coords(pts)
         batch = Z.shape[: Z.ndim - self.coord_axes]
+        nl = self.elem_shape[-1]  # limb count is field-dependent (BN254=16,
+        # BLS12-377=24); hard-coding N_LIMBS here silently garbled any
+        # non-16-limb curve's coordinates
         if self.coord_axes == 1:
-            zinv = self.F.batch_inv(Z.reshape((-1, N_LIMBS))).reshape(Z.shape)
+            zinv = self.F.batch_inv(Z.reshape((-1, nl))).reshape(Z.shape)
         else:
             # Fq2 batch inverse via the norm map: 1/(a0+a1 u) =
             # (a0 - a1 u) / (a0^2 + a1^2), with the Fq norms batch-inverted.
             f = self.F.fq
-            a0 = Z[..., 0, :].reshape((-1, N_LIMBS))
-            a1 = Z[..., 1, :].reshape((-1, N_LIMBS))
+            a0 = Z[..., 0, :].reshape((-1, nl))
+            a1 = Z[..., 1, :].reshape((-1, nl))
             norm = f.add(f.sqr(a0), f.sqr(a1))
             ninv = f.batch_inv(norm)
             zinv = jnp.stack(
                 [f.mul(a0, ninv), f.neg(f.mul(a1, ninv))], axis=-2
-            ).reshape(batch + (2, N_LIMBS))
+            ).reshape(batch + (2, nl))
         x = self.F.mul(X, zinv)
         y = self.F.mul(Y, zinv)
         return jnp.stack([x, y], axis=-1 - self.coord_axes)

@@ -189,50 +189,160 @@ class LimbField:
         """[0, 2p) carried -> canonical [0, p)."""
         return self._cond_sub(a, jnp.asarray(self.p_col))
 
+    # -- group-law plumbing --------------------------------------------------
+    CR = NL  # coordinate rows: one Fq element = 16 limb rows
+
+    def make_ops(self, p, p2, unroll=True):
+        """(mul, add, sub) closures over the consts blocks — the interface
+        the group-law bodies are written against, shared with LimbFq2."""
+        return (
+            lambda x, y: self.mul(x, y, p, unroll),
+            lambda x, y: self.add(x, y, p2, unroll),
+            lambda x, y: self.sub(x, y, p2, unroll),
+        )
+
+    def neg_rows(self, a, p2, unroll=True):
+        return self.neg(a, p2, unroll)
+
+    def canon_rows(self, a):
+        return self.canon(a)
+
+    def b3_limbs(self, b) -> np.ndarray:
+        """3*b Montgomery-encoded as a (16, 1) limb column."""
+        v = 3 * b * self.mont_r % self.p
+        return np.array(to_limbs(v), np.uint32).reshape(NL, 1)
+
+    def one_limbs(self) -> np.ndarray:
+        return np.array(to_limbs(self.mont_r), np.uint32)
+
+
+class LimbFq2:
+    """Fq2 = Fq[u]/(u^2 + 1) on limb-major uint32[32, n]: rows 0-15 c0,
+    16-31 c1. Karatsuba over LimbField's redundant-[0, 2p) Montgomery
+    arithmetic — all component ops stay closed in [0, 2p)."""
+
+    CR = 2 * NL
+
+    def __init__(self, base: LimbField):
+        self.fq = base
+        self.p = base.p
+        self.p_col = base.p_col
+        self.p2_col = base.p2_col
+        self.mont_r = base.mont_r
+
+    def make_ops(self, p, p2, unroll=True):
+        F = self.fq
+
+        def mul(a, b):
+            a0, a1 = a[0:NL], a[NL:]
+            b0, b1 = b[0:NL], b[NL:]
+            t0 = F.mul(a0, b0, p, unroll)
+            t1 = F.mul(a1, b1, p, unroll)
+            c0 = F.sub(t0, t1, p2, unroll)  # u^2 = -1
+            sa = F.add(a0, a1, p2, unroll)
+            sb = F.add(b0, b1, p2, unroll)
+            c1 = F.sub(
+                F.mul(sa, sb, p, unroll), F.add(t0, t1, p2, unroll),
+                p2, unroll,
+            )
+            return jnp.concatenate([c0, c1], axis=0)
+
+        def add(a, b):
+            return jnp.concatenate(
+                [
+                    F.add(a[0:NL], b[0:NL], p2, unroll),
+                    F.add(a[NL:], b[NL:], p2, unroll),
+                ],
+                axis=0,
+            )
+
+        def sub(a, b):
+            return jnp.concatenate(
+                [
+                    F.sub(a[0:NL], b[0:NL], p2, unroll),
+                    F.sub(a[NL:], b[NL:], p2, unroll),
+                ],
+                axis=0,
+            )
+
+        return mul, add, sub
+
+    def neg_rows(self, a, p2, unroll=True):
+        F = self.fq
+        return jnp.concatenate(
+            [F.neg(a[0:NL], p2, unroll), F.neg(a[NL:], p2, unroll)], axis=0
+        )
+
+    def canon_rows(self, a):
+        F = self.fq
+        return jnp.concatenate([F.canon(a[0:NL]), F.canon(a[NL:])], axis=0)
+
+    def b3_limbs(self, b) -> np.ndarray:
+        """3*b' Montgomery-encoded as a (32, 1) limb column (b' in Fq2)."""
+        b0, b1 = b
+        return np.concatenate(
+            [
+                np.array(
+                    to_limbs(3 * b0 * self.mont_r % self.p), np.uint32
+                ).reshape(NL, 1),
+                np.array(
+                    to_limbs(3 * b1 * self.mont_r % self.p), np.uint32
+                ).reshape(NL, 1),
+            ],
+            axis=0,
+        )
+
+    def one_limbs(self) -> np.ndarray:
+        one = np.zeros((2 * NL,), np.uint32)
+        one[:NL] = np.array(to_limbs(self.mont_r), np.uint32)
+        return one
+
 
 @functools.cache
 def lfq() -> LimbField:
     return LimbField(Q)
 
 
+@functools.cache
+def lfq2() -> LimbFq2:
+    return LimbFq2(lfq())
+
+
 # ---------------------------------------------------------------------------
-# G1 group law bodies on limb-major points (48, n): rows 0-15 X, 16-31 Y,
-# 32-47 Z (projective, RCB16 complete formulas, a = 0)
+# Group law bodies on limb-major points (3*CR, n): X rows then Y then Z
+# (projective, RCB16 complete formulas, a = 0). CR = 16 (G1/Fq) or 32
+# (G2/Fq2): the SAME formula code serves both via the field's make_ops.
 # ---------------------------------------------------------------------------
 
 
-class LimbG1:
-    """BN254 G1 on limb-major uint32[48, n]; b = 3, b3 = 9."""
+class LimbGroup:
+    """A short-Weierstrass group (a = 0) on limb-major uint32[3*CR, n]."""
 
-    ROWS = 48
-
-    def __init__(self, field: LimbField | None = None, b: int = 3):
-        self.F = field or lfq()
-        b3 = 3 * b * self.F.mont_r % self.F.p
-        # consts block handed to every kernel: rows 0-15 p, 16-31 2p, 32-47 b3
+    def __init__(self, field, b, tile: int | None = None):
+        self.F = field
+        self.CR = field.CR
+        self.ROWS = 3 * self.CR
+        # Pallas lane tile: halved for Fq2 (double the rows in VMEM)
+        self.tile = tile or (TILE if self.CR == NL else TILE // 2)
+        # consts block handed to every kernel:
+        # rows 0-15 p, 16-31 2p, 32..32+CR b3 (Montgomery)
         self.consts_np = np.concatenate(
-            [
-                self.F.p_col,
-                self.F.p2_col,
-                np.array(to_limbs(b3), np.uint32).reshape(NL, 1),
-            ],
-            axis=0,
+            [field.p_col, field.p2_col, field.b3_limbs(b)], axis=0
         )
-        one = np.array(to_limbs(self.F.mont_r), np.uint32)
-        inf = np.zeros((48,), np.uint32)
-        inf[16:32] = one
-        self.inf_col = inf.reshape(48, 1)
+        inf = np.zeros((self.ROWS,), np.uint32)
+        inf[self.CR : self.CR + field.one_limbs().shape[0]] = (
+            field.one_limbs()
+        )
+        self.inf_col = inf.reshape(self.ROWS, 1)
 
     # -- bodies -------------------------------------------------------------
 
     def add_body(self, p3, q3, consts, unroll=True):
-        F = self.F
-        p, p2, b3c = consts[0:16], consts[16:32], consts[32:48]
-        mul = lambda x, y: F.mul(x, y, p, unroll)
-        add = lambda x, y: F.add(x, y, p2, unroll)
-        sub = lambda x, y: F.sub(x, y, p2, unroll)
-        X1, Y1, Z1 = p3[0:16], p3[16:32], p3[32:48]
-        X2, Y2, Z2 = q3[0:16], q3[16:32], q3[32:48]
+        CR = self.CR
+        p, p2, b3c = consts[0:16], consts[16:32], consts[32:]
+        mul, add, sub = self.F.make_ops(p, p2, unroll)
+        X1, Y1, Z1 = p3[0:CR], p3[CR : 2 * CR], p3[2 * CR :]
+        X2, Y2, Z2 = q3[0:CR], q3[CR : 2 * CR], q3[2 * CR :]
         t0 = mul(X1, X2)
         t1 = mul(Y1, Y2)
         t2 = mul(Z1, Z2)
@@ -250,12 +360,10 @@ class LimbG1:
         return jnp.concatenate([X3, Y3, Z3o], axis=0)
 
     def double_body(self, p3, consts, unroll=True):
-        F = self.F
-        p, p2, b3c = consts[0:16], consts[16:32], consts[32:48]
-        mul = lambda x, y: F.mul(x, y, p, unroll)
-        add = lambda x, y: F.add(x, y, p2, unroll)
-        sub = lambda x, y: F.sub(x, y, p2, unroll)
-        X, Y, Z = p3[0:16], p3[16:32], p3[32:48]
+        CR = self.CR
+        p, p2, b3c = consts[0:16], consts[16:32], consts[32:]
+        mul, add, sub = self.F.make_ops(p, p2, unroll)
+        X, Y, Z = p3[0:CR], p3[CR : 2 * CR], p3[2 * CR :]
         t0 = mul(Y, Y)
         t1 = mul(Y, Z)
         t2 = mul(Z, Z)
@@ -275,9 +383,15 @@ class LimbG1:
         return jnp.concatenate([X3, Y3, Z3], axis=0)
 
     def neg_body(self, p3, consts):
+        CR = self.CR
         p2 = consts[16:32]
         return jnp.concatenate(
-            [p3[0:16], self.F.neg(p3[16:32], p2), p3[32:48]], axis=0
+            [
+                p3[0:CR],
+                self.F.neg_rows(p3[CR : 2 * CR], p2),
+                p3[2 * CR :],
+            ],
+            axis=0,
         )
 
     # -- pallas / XLA dispatch ---------------------------------------------
@@ -300,6 +414,7 @@ class LimbG1:
     @functools.cached_property
     def _pallas_add(self):
         pl, pltpu = _pl()
+        RR, T, CROWS = self.ROWS, self.tile, self.consts_np.shape[0]
 
         def kern(p_ref, q_ref, c_ref, o_ref):
             o_ref[:] = self.add_body(p_ref[:], q_ref[:], c_ref[:])
@@ -309,17 +424,17 @@ class LimbG1:
             n = p.shape[1]
             return pl.pallas_call(
                 kern,
-                out_shape=jax.ShapeDtypeStruct((48, n), jnp.uint32),
-                grid=(n // TILE,),
+                out_shape=jax.ShapeDtypeStruct((RR, n), jnp.uint32),
+                grid=(n // T,),
                 in_specs=[
-                    pl.BlockSpec((48, TILE), lambda i: (0, i),
+                    pl.BlockSpec((RR, T), lambda i: (0, i),
                                  memory_space=pltpu.VMEM),
-                    pl.BlockSpec((48, TILE), lambda i: (0, i),
+                    pl.BlockSpec((RR, T), lambda i: (0, i),
                                  memory_space=pltpu.VMEM),
-                    pl.BlockSpec((48, 1), lambda i: (0, 0),
+                    pl.BlockSpec((CROWS, 1), lambda i: (0, 0),
                                  memory_space=pltpu.VMEM),
                 ],
-                out_specs=pl.BlockSpec((48, TILE), lambda i: (0, i),
+                out_specs=pl.BlockSpec((RR, T), lambda i: (0, i),
                                        memory_space=pltpu.VMEM),
             )(p, q, self._consts())
 
@@ -328,6 +443,7 @@ class LimbG1:
     @functools.cached_property
     def _pallas_double(self):
         pl, pltpu = _pl()
+        RR, T, CROWS = self.ROWS, self.tile, self.consts_np.shape[0]
 
         def kern(p_ref, c_ref, o_ref):
             o_ref[:] = self.double_body(p_ref[:], c_ref[:])
@@ -337,17 +453,17 @@ class LimbG1:
             n = p.shape[1]
             return pl.pallas_call(
                 kern,
-                out_shape=jax.ShapeDtypeStruct((48, n), jnp.uint32),
-                grid=(n // TILE,),
+                out_shape=jax.ShapeDtypeStruct((RR, n), jnp.uint32),
+                grid=(n // T,),
                 in_specs=[
-                    pl.BlockSpec((48, TILE), lambda i: (0, i),
+                    pl.BlockSpec((RR, T), lambda i: (0, i),
                                  memory_space=pltpu.VMEM),
-                    pl.BlockSpec((48, 1), lambda i: (0, 0),
+                    pl.BlockSpec((CROWS, 1), lambda i: (0, 0),
                                  memory_space=pltpu.VMEM),
                 ],
-                out_specs=pl.BlockSpec((48, TILE), lambda i: (0, i),
+                out_specs=pl.BlockSpec((RR, T), lambda i: (0, i),
                                        memory_space=pltpu.VMEM),
-            )(p)
+            )(p, self._consts())
 
         return run
 
@@ -356,11 +472,12 @@ class LimbG1:
         width, run. Power-of-two padding bounds the number of distinct
         compiled shapes (the unrolled group-law graphs are large, so each
         extra shape is a real compile cost on both CPU and TPU)."""
+        RR = self.ROWS
         shape = args[0].shape
-        flat = [a.reshape(48, -1) for a in args]
+        flat = [a.reshape(RR, -1) for a in args]
         n = flat[0].shape[1]
         pallas = use_pallas()
-        granule = TILE if pallas else 256
+        granule = self.tile if pallas else 256
         npad = max(granule, 1 << (n - 1).bit_length())
         if npad != n:
             flat = [jnp.pad(a, ((0, 0), (0, npad - n))) for a in flat]
@@ -368,7 +485,7 @@ class LimbG1:
         return out.reshape(shape)
 
     def add(self, p, q):
-        """Complete add on (48, ...) limb-major batches."""
+        """Complete add on (ROWS, ...) limb-major batches."""
         q = jnp.broadcast_to(q, p.shape)
         return self._batched(self._pallas_add, self._xla_add, (p, q))
 
@@ -376,30 +493,34 @@ class LimbG1:
         return self._batched(self._pallas_double, self._xla_double, (p,))
 
     def neg(self, p):
-        return self.neg_body(p.reshape(48, -1), self._consts()).reshape(p.shape)
+        return self.neg_body(
+            p.reshape(self.ROWS, -1), self._consts()
+        ).reshape(p.shape)
 
     # -- window combine (Horner over c-bit windows), one fused kernel -------
 
     def horner_body(self, getcol, consts, c: int, W: int, unroll=True):
-        """acc = sum_w 2^(c*w) * S_w; getcol(w) -> (48, 1) window sum."""
-        acc0 = jnp.broadcast_to(getcol(W - 1), (48, 128))
+        """acc = sum_w 2^(c*w) * S_w; getcol(w) -> (ROWS, 1) window sum."""
+        RR = self.ROWS
+        acc0 = jnp.broadcast_to(getcol(W - 1), (RR, 128))
 
         def step(i, acc):
             w = W - 2 - i
             for _ in range(c):
                 acc = self.double_body(acc, consts, unroll)
             return self.add_body(
-                acc, jnp.broadcast_to(getcol(w), (48, 128)), consts, unroll
+                acc, jnp.broadcast_to(getcol(w), (RR, 128)), consts, unroll
             )
 
         return jax.lax.fori_loop(0, W - 1, step, acc0)
 
     @functools.cache
     def _horner(self, c: int, W: int):
+        RR = self.ROWS
         if not use_pallas():
             return jax.jit(
                 lambda s: self.horner_body(
-                    lambda w: jax.lax.dynamic_slice(s, (0, w), (48, 1)),
+                    lambda w: jax.lax.dynamic_slice(s, (0, w), (RR, 1)),
                     self._consts(), c, W, unroll=False,
                 )[:, :1]
             )
@@ -425,7 +546,7 @@ class LimbG1:
         def run(s):
             out = pl.pallas_call(
                 kern,
-                out_shape=jax.ShapeDtypeStruct((48, 128), jnp.uint32),
+                out_shape=jax.ShapeDtypeStruct((RR, 128), jnp.uint32),
                 in_specs=[
                     pl.BlockSpec(memory_space=pltpu.VMEM),
                     pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -437,7 +558,7 @@ class LimbG1:
         return run
 
     def horner(self, s, c: int):
-        """Window sums s (48, W), LSB window first -> single point (48, 1)."""
+        """Window sums s (ROWS, W), LSB window first -> one point column."""
         W = s.shape[1]
         if W == 1:
             return s
@@ -445,27 +566,48 @@ class LimbG1:
 
     # -- layout conversion ---------------------------------------------------
 
+    @property
+    def rm_shape(self) -> tuple:
+        """Trailing row-major point shape: (3, 16) G1, (3, 2, 16) G2."""
+        return (3, 16) if self.CR == NL else (3, 2, 16)
+
     def from_rowmajor(self, pts):
-        """(n, 3, 16) row-major (canonical Montgomery) -> (48, n)."""
+        """(n,) + rm_shape row-major (canonical Montgomery) -> (ROWS, n)."""
         n = pts.shape[0]
-        return jnp.transpose(pts.reshape(n, 48))
+        return jnp.transpose(pts.reshape(n, self.ROWS))
 
     def to_rowmajor(self, lm, canonical: bool = True):
-        """(48, n) -> (n, 3, 16) row-major; canonicalises to [0, p)."""
+        """(ROWS, n) -> (n,) + rm_shape row-major; canonicalises to [0, p)."""
         if canonical:
             lm = jnp.concatenate(
-                [self.F.canon(lm[i * 16 : (i + 1) * 16]) for i in range(3)],
+                [
+                    self.F.canon_rows(lm[i * self.CR : (i + 1) * self.CR])
+                    for i in range(3)
+                ],
                 axis=0,
             )
-        return jnp.transpose(lm).reshape(-1, 3, 16)
+        return jnp.transpose(lm).reshape((-1,) + self.rm_shape)
 
     def infinity(self, n: int):
-        return jnp.broadcast_to(jnp.asarray(self.inf_col), (48, n))
+        return jnp.broadcast_to(jnp.asarray(self.inf_col), (self.ROWS, n))
+
+
+# Back-compat name: the original G1-only class was called LimbG1.
+LimbG1 = LimbGroup
 
 
 @functools.cache
-def lg1() -> LimbG1:
-    return LimbG1()
+def lg1() -> LimbGroup:
+    from .constants import G1_B
+
+    return LimbGroup(lfq(), G1_B)
+
+
+@functools.cache
+def lg2() -> LimbGroup:
+    from .constants import G2_B
+
+    return LimbGroup(lfq2(), G2_B)
 
 
 # ---------------------------------------------------------------------------
@@ -487,11 +629,12 @@ def _digits(scalars_std, c: int):
 
 def msm_tree(points_rm, scalars_std, c: int | None = None,
              window_group: int | None = None):
-    """sum_i scalars[i] * points[i] on BN254 G1, limb-major TPU path.
+    """sum_i scalars[i] * points[i] on BN254 G1 or G2, limb-major TPU path.
 
-    points_rm: (n, 3, 16) projective row-major (Montgomery, canonical);
-    scalars_std: (n, 16) uint32 standard form. Returns (3, 16) row-major
-    canonical projective point.
+    points_rm: (n, 3, 16) G1 / (n, 3, 2, 16) G2 projective row-major
+    (Montgomery, canonical) — the group is inferred from the rank;
+    scalars_std: (n, 16) uint32 standard form. Returns (3, 16) or
+    (3, 2, 16) row-major canonical projective point.
 
     Per window: points are ordered by digit (argsort), reduced by a pairwise
     sum tree (n-1 adds — vs 2n for an associative_scan — with every level a
@@ -510,12 +653,14 @@ def msm_tree(points_rm, scalars_std, c: int | None = None,
         # the Fenwick/combine stages scale with B = 2^c per window: a small
         # MSM with c=8 would spend everything on 255 empty buckets
         c = 8 if points_rm.shape[0] >= 4096 else 4
-    return _msm_tree_jit(points_rm, scalars_std, c, window_group)
+    g = lg2() if points_rm.ndim == 4 else lg1()
+    return _msm_tree_jit(g, points_rm, scalars_std, c, window_group)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _msm_tree_jit(points_rm, scalars_std, c: int, window_group: int | None):
-    g = lg1()
+@functools.partial(jax.jit, static_argnums=(0, 3, 4))
+def _msm_tree_jit(g: LimbGroup, points_rm, scalars_std, c: int,
+                  window_group: int | None):
+    RR = g.ROWS
     n = points_rm.shape[0]
     W_all = 256 // c
     B = 1 << c
@@ -530,7 +675,10 @@ def _msm_tree_jit(points_rm, scalars_std, c: int, window_group: int | None):
 
     if window_group is None:
         # bound live tree memory to ~8 * 48 * 2^20 * 4 * 2 ≈ 3.2 GB
-        window_group = W_all if npad <= (1 << 17) else 8
+        # (half the window count for G2's double-width rows)
+        window_group = (
+            W_all if npad <= (1 << 17) else max(1, 8 * 48 // RR)
+        )
 
     sums = []
     for w0 in range(0, W_all, window_group):
@@ -541,24 +689,24 @@ def _msm_tree_jit(points_rm, scalars_std, c: int, window_group: int | None):
         ends = jax.vmap(
             lambda row: jnp.searchsorted(row, jnp.arange(B - 1), side="right")
         )(sortd)  # (Wg, B-1)
-        gathered = jnp.take(lm, order.reshape(-1), axis=1).reshape(48, Wg, npad)
+        gathered = jnp.take(lm, order.reshape(-1), axis=1).reshape(RR, Wg, npad)
 
-        # Up-sweep; each level is also kept transposed to (Wg*K, 48) so the
-        # Fenwick node lookups below are contiguous 192-byte row gathers
-        # (embedding-style) instead of 48-way strided minor-axis gathers.
+        # Up-sweep; each level is also kept transposed to (Wg*K, ROWS) so
+        # the Fenwick node lookups below are contiguous row gathers
+        # (embedding-style) instead of ROWS-way strided minor-axis gathers.
         lvls_t = []
         x = gathered
-        lvls_t.append(jnp.transpose(x, (1, 2, 0)).reshape(-1, 48))
+        lvls_t.append(jnp.transpose(x, (1, 2, 0)).reshape(-1, RR))
         for _ in range(levels_n):
             k = x.shape[-1]
-            pair = x.reshape(48, Wg, k // 2, 2)
+            pair = x.reshape(RR, Wg, k // 2, 2)
             x = g.add(pair[..., 0], pair[..., 1])
-            lvls_t.append(jnp.transpose(x, (1, 2, 0)).reshape(-1, 48))
-        total = x[..., 0:1]  # (48, Wg, 1)
+            lvls_t.append(jnp.transpose(x, (1, 2, 0)).reshape(-1, RR))
+        total = x[..., 0:1]  # (RR, Wg, 1)
 
         # Fenwick prefix at the B-1 bucket boundaries: gather one node per
         # level per boundary, then sum the levels with a pairwise tree.
-        inf_row = jnp.asarray(g.inf_col)[:, 0]  # (48,)
+        inf_row = jnp.asarray(g.inf_col)[:, 0]  # (RR,)
         nodes = []
         for d in range(levels_n + 1):
             pd = ends >> d
@@ -566,25 +714,25 @@ def _msm_tree_jit(points_rm, scalars_std, c: int, window_group: int | None):
             idx = jnp.maximum(pd - 1, 0)
             k = npad >> d
             flat = (jnp.arange(Wg)[:, None] * k + idx).reshape(-1)
-            node = jnp.take(lvls_t[d], flat, axis=0).reshape(Wg, B - 1, 48)
+            node = jnp.take(lvls_t[d], flat, axis=0).reshape(Wg, B - 1, RR)
             node = jnp.where(takebit[..., None], node, inf_row)
             nodes.append(node)
         D = len(nodes)
         dpad = 1 << (D - 1).bit_length()
-        stack = jnp.stack(nodes, axis=0)  # (D, Wg, B-1, 48)
+        stack = jnp.stack(nodes, axis=0)  # (D, Wg, B-1, RR)
         if dpad != D:
             stack = jnp.concatenate(
                 [
                     stack,
-                    jnp.broadcast_to(inf_row, (dpad - D, Wg, B - 1, 48)),
+                    jnp.broadcast_to(inf_row, (dpad - D, Wg, B - 1, RR)),
                 ],
                 axis=0,
             )
-        stack = jnp.transpose(stack, (3, 0, 1, 2))  # (48, dpad, Wg, B-1)
+        stack = jnp.transpose(stack, (3, 0, 1, 2))  # (RR, dpad, Wg, B-1)
         while stack.shape[1] > 1:
             half = stack.shape[1] // 2
             stack = g.add(stack[:, :half], stack[:, half:])
-        acc = stack[:, 0]  # (48, Wg, B-1)
+        acc = stack[:, 0]  # (RR, Wg, B-1)
 
         # sum_b b * S_b = sum_{j=0..B-2} (total - C_j)
         terms = g.add(jnp.broadcast_to(total, acc.shape), g.neg(acc))
@@ -595,17 +743,73 @@ def _msm_tree_jit(points_rm, scalars_std, c: int, window_group: int | None):
                     [
                         terms,
                         jnp.broadcast_to(
-                            jnp.asarray(g.inf_col)[:, :, None], (48, Wg, 1)
+                            jnp.asarray(g.inf_col)[:, :, None], (RR, Wg, 1)
                         ),
                     ],
                     axis=-1,
                 )
                 k += 1
-            pair = terms.reshape(48, Wg, k // 2, 2)
+            pair = terms.reshape(RR, Wg, k // 2, 2)
             terms = g.add(pair[..., 0], pair[..., 1])
             k //= 2
-        sums.append(terms[..., 0])  # (48, Wg)
+        sums.append(terms[..., 0])  # (RR, Wg)
 
-    s_all = jnp.concatenate(sums, axis=1)  # (48, W_all)
-    out = g.horner(s_all, c)  # (48, 1)
+    s_all = jnp.concatenate(sums, axis=1)  # (RR, W_all)
+    out = g.horner(s_all, c)  # (RR, 1)
     return g.to_rowmajor(out)[0]
+
+
+# ---------------------------------------------------------------------------
+# Fixed-scalar ladder application: out[..., o] = sum_k M[o][k] * pts[..., k]
+# (the in-the-exponent PSS pack/unpack maps, parallel/pss.py). The ladder
+# body is the same batched add/double/select sweep the row-major path runs,
+# but on limb-major tensors the adds ride the Pallas kernels.
+# ---------------------------------------------------------------------------
+
+
+def ladder_apply(g: LimbGroup, pts_lm, bits, signs, nbits: int):
+    """pts_lm: (ROWS, B, K) limb-major bases (already GLV-expanded when the
+    caller uses the endomorphism); bits: (o, K, nbits) uint32; signs:
+    (o, K) bool or None. Returns (ROWS, B, o) limb-major points."""
+    RR = g.ROWS
+    B, K = pts_lm.shape[1], pts_lm.shape[2]
+    o = bits.shape[0]
+    acc0 = jnp.broadcast_to(
+        jnp.asarray(g.inf_col).reshape(RR, 1, 1, 1), (RR, B, o, K)
+    )
+
+    def body(i, state):
+        acc, base = state
+        bit = bits[..., i]  # (o, K)
+        addend = base[:, :, None, :]  # (ROWS, B, 1, K)
+        if signs is not None:
+            # (o, K) broadcasts against (ROWS, B, 1, K) -> (ROWS, B, o, K)
+            addend = jnp.where(signs, g.neg(addend), addend)
+        cand = g.add(acc, jnp.broadcast_to(addend, acc.shape))
+        acc = jnp.where(bit == 1, cand, acc)
+        return acc, g.double(base)
+
+    acc, _ = jax.lax.fori_loop(0, nbits, body, (acc0, pts_lm))
+    # pairwise tree-sum over the K axis (K is a power of two in practice;
+    # pad with infinity otherwise)
+    k = K
+    x = acc
+    while k > 1:
+        if k % 2:
+            x = jnp.concatenate(
+                [x, jnp.broadcast_to(
+                    jnp.asarray(g.inf_col).reshape(RR, 1, 1, 1),
+                    (RR, B, o, 1))],
+                axis=-1,
+            )
+            k += 1
+        pair = x.reshape(RR, B, o, k // 2, 2)
+        x = g.add(pair[..., 0], pair[..., 1])
+        k //= 2
+    return x[..., 0]  # (ROWS, B, o)
+
+
+# eager fori_loop dispatch is an XLA:CPU crash class in this environment
+# (backend_compile_and_load segfault late in a long-lived process): always
+# enter the ladder through this jitted wrapper
+ladder_apply_jit = jax.jit(ladder_apply, static_argnums=(0, 4))

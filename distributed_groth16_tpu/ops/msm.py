@@ -103,13 +103,19 @@ def _msm_ladder_jit(curve: CurvePoints, points, scalars):
 
 
 def _tree_path_ok(curve: CurvePoints, n: int) -> bool:
-    """Route G1 MSMs to the limb-major tree path (ops/limb_kernels.py) on
-    TPU backends — the Pallas fast path — or anywhere when forced via
-    DG16_FORCE_TREE_MSM=1 (tests exercise the identical XLA bodies on CPU)."""
+    """Route BN254 G1 AND G2 MSMs to the limb-major tree path
+    (ops/limb_kernels.py) on TPU backends — the Pallas fast path — or
+    anywhere when forced via DG16_FORCE_TREE_MSM=1 (tests exercise the
+    identical XLA bodies on CPU)."""
     import os
 
-    if curve.elem_shape != (N_LIMBS,):
-        return False  # G2 / Fq2 curves stay on the generic path for now
+    from .constants import Q as _BN254_Q
+
+    if curve.elem_shape not in ((N_LIMBS,), (2, N_LIMBS)):
+        return False
+    base_p = curve.F.p if hasattr(curve.F, "p") else curve.F.fq.p
+    if base_p != _BN254_Q:
+        return False  # limb singletons are BN254-moduli; see lfq()/lfq2()
     if os.environ.get("DG16_FORCE_TREE_MSM") == "1":
         return True
     from .limb_kernels import use_pallas

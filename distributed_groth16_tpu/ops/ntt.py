@@ -107,13 +107,18 @@ class JaxDomain:
         x = _zpad(coeffs, self.size)
         if self._off_pows is not None:
             x = F.mul(x, self._off_pows)
+        if _limb_ntt_ok(self.size):
+            return _limb_ntt_route(x, self.size, False)
         return _ntt_core(x, self._perm, self._wpows, self.logn)
 
     def ifft(self, evals):
         """Interpolate: (..., k<=n, 16) evals -> (..., n, 16) coeffs."""
         F = fr()
         x = _zpad(evals, self.size)
-        x = _ntt_core(x, self._perm, self._wpows, self.logn, inverse=True)
+        if _limb_ntt_ok(self.size):
+            x = _limb_ntt_route(x, self.size, True)
+        else:
+            x = _ntt_core(x, self._perm, self._wpows, self.logn, inverse=True)
         x = F.mul(x, self._size_inv)
         if self._off_inv_pows is not None:
             x = F.mul(x, self._off_inv_pows)
@@ -121,6 +126,37 @@ class JaxDomain:
 
     def get_coset(self, offset: int) -> "JaxDomain":
         return domain(self.size, offset * self.offset % R)
+
+
+def _limb_ntt_ok(n: int) -> bool:
+    """Route big transforms to the limb-major Pallas path (ops/ntt_limb.py)
+    on TPU backends, or anywhere under DG16_FORCE_LIMB_NTT=1 (differential
+    tests exercise the identical XLA bodies on CPU). Small transforms keep
+    the row-major fori core: the limb path's layout transposes only pay
+    off when the butterfly work dominates."""
+    import os
+
+    if os.environ.get("DG16_FORCE_LIMB_NTT") == "1":
+        return True
+    from .limb_kernels import use_pallas
+
+    return use_pallas() and n >= 2048
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _limb_ntt_route(x, n: int, inverse: bool):
+    """(..., n, 16) row-major <-> limb-major shim around ntt_limb (no 1/n
+    scaling — the caller's ifft applies size_inv, as with _ntt_core)."""
+    from .ntt_limb import ntt_limb
+
+    batch = x.shape[:-2]
+    flat = x.reshape((-1, n, N_LIMBS))
+
+    def one(v):  # (n, 16) -> (n, 16)
+        return jnp.transpose(ntt_limb(jnp.transpose(v), n, inverse))
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(batch + (n, N_LIMBS))
 
 
 def _zpad(x, n):

@@ -47,7 +47,10 @@ def batch_inv(xs, p: int):
 
 
 class Domain:
-    """Mirror of ark-poly Radix2EvaluationDomain over BN254 Fr.
+    """Mirror of ark-poly Radix2EvaluationDomain — over BN254 Fr by
+    default, or any prime scalar field via (modulus, generator) (the
+    reference instantiates domains over BLS12-377 Fr too,
+    dist-primitives/examples/dmsm_bench.rs:46).
 
     fft(coeffs)  : evaluate at offset * w^i for i in 0..size
     ifft(evals)  : inverse; inputs shorter than size are zero-padded (ark
@@ -55,50 +58,58 @@ class Domain:
     get_coset(g) : same group generator, offset multiplied in.
     """
 
-    def __init__(self, size: int, offset: int = 1):
+    def __init__(self, size: int, offset: int = 1,
+                 modulus: int = R, generator: int = FR_GENERATOR):
         assert size & (size - 1) == 0, "domain size must be a power of two"
-        assert size <= (1 << FR_TWO_ADICITY)
+        r = modulus
+        two_adicity = ((r - 1) & -(r - 1)).bit_length() - 1
+        assert size <= (1 << two_adicity)
         self.size = size
-        self.offset = offset % R
-        self.group_gen = pow(FR_GENERATOR, (R - 1) // size, R)
-        self.group_gen_inv = finv(self.group_gen, R)
-        self.size_inv = finv(size, R)
-        self.offset_inv = finv(self.offset, R) if offset != 1 else 1
+        self.r = r
+        self.generator = generator
+        self.offset = offset % r
+        self.group_gen = pow(generator, (r - 1) // size, r)
+        self.group_gen_inv = finv(self.group_gen, r)
+        self.size_inv = finv(size, r)
+        self.offset_inv = finv(self.offset, r) if offset != 1 else 1
 
     def get_coset(self, offset: int) -> "Domain":
-        return Domain(self.size, offset * self.offset % R)
+        return Domain(self.size, offset * self.offset % self.r,
+                      self.r, self.generator)
 
     def elements(self):
         w, acc = self.group_gen, self.offset
         out = []
         for _ in range(self.size):
             out.append(acc)
-            acc = acc * w % R
+            acc = acc * w % self.r
         return out
 
     def _pad(self, v):
-        v = [x % R for x in v]
+        v = [x % self.r for x in v]
         assert len(v) <= self.size
         return v + [0] * (self.size - len(v))
 
     def fft(self, coeffs):
+        r = self.r
         c = self._pad(coeffs)
         if self.offset != 1:
             mul, off = 1, self.offset
             for i in range(self.size):
-                c[i] = c[i] * mul % R
-                mul = mul * off % R
-        return _ntt(c, self.group_gen)
+                c[i] = c[i] * mul % r
+                mul = mul * off % r
+        return _ntt(c, self.group_gen, r)
 
     def ifft(self, evals):
+        r = self.r
         e = self._pad(evals)
-        c = _ntt(e, self.group_gen_inv)
-        c = [x * self.size_inv % R for x in c]
+        c = _ntt(e, self.group_gen_inv, r)
+        c = [x * self.size_inv % r for x in c]
         if self.offset != 1:
             mul, off_inv = 1, self.offset_inv
             for i in range(self.size):
-                c[i] = c[i] * mul % R
-                mul = mul * off_inv % R
+                c[i] = c[i] * mul % r
+                mul = mul * off_inv % r
         return c
 
 
@@ -113,21 +124,21 @@ def bit_reverse_permute(v):
     return out
 
 
-def _ntt(v, w):
+def _ntt(v, w, r: int = R):
     """Iterative radix-2 Cooley-Tukey NTT (DIT, natural in/natural out)."""
     n = len(v)
     v = bit_reverse_permute(v)
     span = 1
     while span < n:
-        wspan = pow(w, n // (2 * span), R)
+        wspan = pow(w, n // (2 * span), r)
         for start in range(0, n, 2 * span):
             wj = 1
             for j in range(span):
                 a = v[start + j]
-                b = v[start + j + span] * wj % R
-                v[start + j] = (a + b) % R
-                v[start + j + span] = (a - b) % R
-                wj = wj * wspan % R
+                b = v[start + j + span] * wj % r
+                v[start + j] = (a + b) % r
+                v[start + j + span] = (a - b) % r
+                wj = wj * wspan % r
         span *= 2
     return v
 

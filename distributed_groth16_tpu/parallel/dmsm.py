@@ -26,11 +26,17 @@ async def d_msm(
     pp: PackedSharingParams,
     net: Net,
     sid: int = 0,
+    scalar_field=None,
 ):
     """bases: (c, 3) + elem packed-in-the-exponent CRS shares;
     scalar_shares: (c, 16) Montgomery-form packed witness shares.
-    Returns the clear MSM result (3,) + elem on every party."""
-    F = fr()
+    Returns the clear MSM result (3,) + elem on every party.
+
+    scalar_field: the PrimeField the shares live in — defaults to BN254
+    Fr; pass ops.bls12_377.fr377() (with pp = bls12_377.pss377(l)) for the
+    reference's BLS12-377 configuration (dmsm_bench.rs:42-50; d_msm itself
+    is curve-generic there, dmsm/mod.rs:70)."""
+    F = scalar_field or fr()
     local = msm(curve, bases, F.from_mont(scalar_shares))
 
     def king(points):

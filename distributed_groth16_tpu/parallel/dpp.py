@@ -25,6 +25,7 @@ async def d_pp(num, den, pp: PackedSharingParams, net: Net, sid: int = 0):
     F = fr()
     numden = jnp.concatenate([num, den], axis=0)  # (2c, 16)
 
+    @jax.jit  # eager associative_scan dispatch is an XLA:CPU crash class
     def king(vals):
         x = jnp.swapaxes(jnp.stack(vals, axis=0), 0, 1)  # (2c, n, 16)
         secrets = pp.unpack2(x).reshape(-1, 16)  # (2c*l, 16) chunk-major

@@ -44,6 +44,12 @@ def fixed_scalar_mul(curve: CurvePoints, pts, tensors):
     bits, signs, nbits = tensors
     bits = jnp.asarray(bits)  # cache holds host arrays (tracer hygiene)
     signs = None if signs is None else jnp.asarray(signs)
+    return _fixed_scalar_mul_jit(curve, nbits, pts, bits, signs)
+
+
+# jitted: eager fori/scan dispatch is an XLA:CPU crash class here
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _fixed_scalar_mul_jit(curve: CurvePoints, nbits: int, pts, bits, signs):
     ax = pts.ndim - 2 - curve.coord_axes  # lane axis
     batch = pts.shape[:ax]
     base = jnp.expand_dims(pts, ax)  # (..., 1, n) + point

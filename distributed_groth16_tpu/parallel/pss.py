@@ -39,28 +39,57 @@ from ..ops.ntt import domain
 
 
 class PackedSharingParams:
-    """PSS parameters and transforms for packing factor l (n = 4l parties)."""
+    """PSS parameters and transforms for packing factor l (n = 4l parties).
 
-    def __init__(self, l: int):
+    Defaults to BN254 Fr. Passing another (modulus, generator) — e.g.
+    BLS12-377's — builds the HOST domains (and hence the pack/unpack
+    matrices and every in-the-exponent map) over that field; the DEVICE
+    field-share transforms stay BN254-only (their NTT/encode stack is
+    built over ops/constants.R) and raise loudly if called.
+    """
+
+    def __init__(self, l: int, modulus: int = R,
+                 generator: int = FR_GENERATOR):
         assert l >= 1 and (l & (l - 1)) == 0, "packing factor must be a power of 2"
         self.l = l
         self.t = l - 1
         self.n = 4 * l
+        self.modulus = modulus
         assert self.n == 2 * (self.t + self.l + 1)
-        self.share = domain(self.n)
-        self.secret = domain(self.l + self.t + 1, offset=FR_GENERATOR)
-        self.secret2 = domain(2 * (self.l + self.t + 1), offset=FR_GENERATOR)
+        if modulus == R:
+            self.share = domain(self.n)
+            self.secret = domain(self.l + self.t + 1, offset=FR_GENERATOR)
+            self.secret2 = domain(
+                2 * (self.l + self.t + 1), offset=FR_GENERATOR
+            )
+        else:
+            self.share = self.secret = self.secret2 = None
         # host-side mirrors for matrix construction / ground truth
-        self.share_h = rm.Domain(self.n)
-        self.secret_h = rm.Domain(self.l + self.t + 1, offset=FR_GENERATOR)
-        self.secret2_h = rm.Domain(2 * (self.l + self.t + 1), offset=FR_GENERATOR)
+        self.share_h = rm.Domain(self.n, modulus=modulus,
+                                 generator=generator)
+        self.secret_h = rm.Domain(self.l + self.t + 1, offset=generator,
+                                  modulus=modulus, generator=generator)
+        self.secret2_h = rm.Domain(2 * (self.l + self.t + 1),
+                                   offset=generator, modulus=modulus,
+                                   generator=generator)
+
+    def _device_domains(self):
+        if self.share is None:
+            raise NotImplementedError(
+                "device field-share transforms are BN254-Fr-only; this "
+                "PackedSharingParams was built over a different scalar "
+                "field (pack scalars host-side, e.g. "
+                "bls12_377.pack_scalars_377)"
+            )
+        return self.share, self.secret, self.secret2
 
     # -- field-vector transforms (batched over leading axes) ------------------
 
     def pack_from_public(self, secrets):
         """(..., l, 16) secrets -> (..., n, 16) shares."""
         assert secrets.shape[-2] == self.l
-        return self.share.fft(self.secret.ifft(secrets))
+        share, secret, _ = self._device_domains()
+        return share.fft(secret.ifft(secrets))
 
     def pack_from_public_rand(self, secrets, rng: np.random.Generator):
         """Packing with t+1 uniform-in-Fr random filler points — the hiding
@@ -82,19 +111,22 @@ class PackedSharingParams:
         vals = acc % R
         rand = fr().encode(vals.reshape(batch + (self.t + 1,)))
         full = jnp.concatenate([secrets, rand], axis=-2)
-        return self.share.fft(self.secret.ifft(full))
+        share, secret, _ = self._device_domains()
+        return share.fft(secret.ifft(full))
 
     def unpack(self, shares):
         """(..., n, 16) degree-(t+l) shares -> (..., l, 16) secrets."""
         assert shares.shape[-2] == self.n
-        coeffs = self.share.ifft(shares)[..., : self.secret.size, :]
-        return self.secret.fft(coeffs)[..., : self.l, :]
+        share, secret, _ = self._device_domains()
+        coeffs = share.ifft(shares)[..., : secret.size, :]
+        return secret.fft(coeffs)[..., : self.l, :]
 
     def unpack2(self, shares):
         """(..., n, 16) degree-2(t+l) shares -> (..., l, 16) secrets."""
         assert shares.shape[-2] == self.n
-        coeffs = self.share.ifft(shares)
-        evals = self.secret2.fft(coeffs)
+        share, _, secret2 = self._device_domains()
+        coeffs = share.ifft(shares)
+        evals = secret2.fft(coeffs)
         return evals[..., : 2 * self.l : 2, :]
 
     # -- linear maps as explicit Fr matrices (for group elements) ------------
@@ -201,6 +233,13 @@ class PackedSharingParams:
         chain runs on the (..., K) base set only (row-independent); the
         conditional (sign-adjusted) adds run batched over (..., o, K). Then
         a log-K tree sum over the K axis.
+
+        Both ladder paths run under jit: eagerly-dispatched scan/fori
+        executables are an XLA:CPU crash class in this environment
+        (segfault in backend_compile_and_load once enough executables are
+        live in the process — the class prove._maybe_mul dodged by going
+        host-side; reproduced at test_pss.py:108 via eager
+        sum_sequential).
         """
         bits, signs, nbits = self._ladder_tensors(curve, which)
         bits = jnp.asarray(bits)  # cache holds host arrays (tracer hygiene)
@@ -212,25 +251,23 @@ class PackedSharingParams:
         if curve.glv is not None:
             base = jnp.concatenate([pts, curve.endo(pts)], axis=ax)
         K = base.shape[ax]
-        acc = jnp.broadcast_to(
-            curve.infinity(),
-            batch + (o, K, 3) + curve.elem_shape,
-        )
 
-        def body(i, state):
-            acc, base = state
-            bit = bits[..., i]  # (o, K)
-            addend = jnp.expand_dims(base, ax)
-            if signs is not None:
-                addend = curve.select(signs, curve.neg(addend), addend)
-            cand = curve.add(acc, addend)
-            acc = curve.select(bit == 1, cand, acc)
-            return acc, curve.double(base)
+        # TPU fast path: run the ladder limb-major so every add/double in
+        # the nbits-step sweep rides the Pallas kernels — CRS packing was
+        # 74% of the million-2^12 wall-clock on the row-major path.
+        from ..ops.msm import _tree_path_ok
 
-        acc, _ = jax.lax.fori_loop(0, nbits, body, (acc, base))
-        # K is small (<= 2n): sequential accumulation is one add instance,
-        # the compile-light reduction (VERDICT r2 weak #3)
-        return curve.sum_sequential(acc, axis=len(batch) + 1)
+        B = int(np.prod(batch, dtype=np.int64)) if batch else 1
+        if _tree_path_ok(curve, B * o * K):
+            from ..ops.limb_kernels import ladder_apply_jit, lg1, lg2
+
+            g = lg1() if curve.coord_axes == 1 else lg2()
+            rm_flat = base.reshape((B * K,) + (3,) + curve.elem_shape)
+            lm = g.from_rowmajor(rm_flat).reshape(g.ROWS, B, K)
+            out_lm = ladder_apply_jit(g, lm, bits, signs, nbits)
+            out_rm = g.to_rowmajor(out_lm.reshape(g.ROWS, B * o))
+            return out_rm.reshape(batch + (o, 3) + curve.elem_shape)
+        return _dense_ladder_jit(curve, ax, nbits, base, bits, signs)
 
     def packexp_from_public(self, curve: CurvePoints, pts, method="auto"):
         """(..., l) + point -> (..., n) + point (dmsm/mod.rs:61-68)."""
@@ -256,6 +293,35 @@ class PackedSharingParams:
             return "ntt" if self.n >= self._NTT_THRESHOLD else "dense"
         assert method in ("dense", "ntt")
         return method
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _dense_ladder_jit(curve: CurvePoints, ax: int, nbits: int,
+                      base, bits, signs):
+    """Row-major fixed-scalar ladder + sequential K-reduction as ONE jitted
+    program (see _apply_point_matrix's crash-class note)."""
+    o = bits.shape[0]
+    batch = base.shape[:ax]
+    K = base.shape[ax]
+    acc = jnp.broadcast_to(
+        curve.infinity(),
+        batch + (o, K, 3) + curve.elem_shape,
+    )
+
+    def body(i, state):
+        acc, b = state
+        bit = bits[..., i]  # (o, K)
+        addend = jnp.expand_dims(b, ax)
+        if signs is not None:
+            addend = curve.select(signs, curve.neg(addend), addend)
+        cand = curve.add(acc, addend)
+        acc = curve.select(bit == 1, cand, acc)
+        return acc, curve.double(b)
+
+    acc, _ = jax.lax.fori_loop(0, nbits, body, (acc, base))
+    # K is small (<= 2n): sequential accumulation is one add instance,
+    # the compile-light reduction (VERDICT r2 weak #3)
+    return curve.sum_sequential(acc, axis=len(batch) + 1)
 
 
 @functools.cache

@@ -1,0 +1,152 @@
+"""Per-party Groth16 cost model: the 6 FFTs + 5 MSMs one party executes
+per distributed proof, timed phase by phase on this machine's backend.
+
+TPU-native counterpart of the reference's local_groth_bench
+(groth16/examples/local_groth_bench.rs:54-158): same operation inventory —
+3 IFFT(m) + 3 FFT(2m) + 1 IFFT(2m) over Fr, then the five query MSMs
+S(m)·G1, V(m)·G2, H(m)·G1, W(m)·G1, U(2m)·G1 — plus the reference's
+preprocessing/memory accounting (its rs:55-80 comment block) evaluated for
+the chosen (m, l). Usage:
+
+    python examples/local_groth_bench.py [--log2-m 15] [--l 2]
+
+Prints one JSON line per phase and a final summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log2-m", type=int, default=15)
+    ap.add_argument("--l", type=int, default=2,
+                    help="packing parameter (memory accounting only)")
+    ap.add_argument("--g2", action="store_true", default=True)
+    ap.add_argument("--no-g2", dest="g2", action="store_false",
+                    help="skip the V·G2 MSM (fast smoke runs)")
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_groth16_tpu.ops.constants import (
+        G1_GENERATOR,
+        G2_GENERATOR,
+        R,
+    )
+    from distributed_groth16_tpu.ops.curve import g1, g2
+    from distributed_groth16_tpu.ops.field import fr
+    from distributed_groth16_tpu.ops.msm import encode_scalars_std, msm
+    from distributed_groth16_tpu.ops.ntt import domain
+    from distributed_groth16_tpu.ops import refmath as rm
+
+    m = 1 << args.log2_m
+    l = args.l
+    F = fr()
+    dom = domain(m)
+    dom2 = domain(2 * m)
+
+    # --- memory / preprocessing accounting (rs:55-80) ----------------------
+    # field-element counts, in units of m/l shares per party
+    acct = {
+        "preprocessing_uvw_shares": 21 * m // l,  # 3x (m/l + 2*2m/l + 2m/l)
+        "preprocessing_h_shares": 4 * m // l,
+        "uvw_live_shares": 3 * (2 * m // l),
+        "h_live_shares": 2 * m // l,
+        "crs_g1_points": 4 * m + m,  # s + w + h (m each) + u (2m)
+        "crs_g2_points": m,
+    }
+    print(json.dumps({"phase": "accounting", "m": m, "l": l, **acct}))
+
+    def timed(name, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        if hasattr(out, "block_until_ready"):
+            out.block_until_ready()
+        dt = time.perf_counter() - t0
+        print(json.dumps({"phase": name, "seconds": round(dt, 4)}))
+        return out, dt
+
+    total = 0.0
+
+    # --- 6 FFTs + h combine (rs:85-122) ------------------------------------
+    rng = np.random.default_rng(0)
+    ev = F.encode([int(x) for x in rng.integers(0, 1 << 62, size=m)])
+    p_ev = q_ev = w_ev = ev
+
+    c_p, dt = timed("ifft_m_p", lambda: dom.ifft(p_ev)); total += dt
+    c_q, dt = timed("ifft_m_q", lambda: dom.ifft(q_ev)); total += dt
+    c_w, dt = timed("ifft_m_w", lambda: dom.ifft(w_ev)); total += dt
+    e_p, dt = timed("fft_2m_p", lambda: dom2.fft(c_p)); total += dt
+    e_q, dt = timed("fft_2m_q", lambda: dom2.fft(c_q)); total += dt
+    e_w, dt = timed("fft_2m_w", lambda: dom2.fft(c_w)); total += dt
+    h_ev, dt = timed(
+        "h_combine", lambda: F.sub(F.mul(e_p, e_q), e_w)
+    ); total += dt
+    h_coeff, dt = timed("ifft_2m_h", lambda: dom2.ifft(h_ev)); total += dt
+
+    # --- dummy CRS (rs:21-52: doubling chains off a random base) -----------
+    C1, C2 = g1(), g2()
+
+    def chain_g1(k):
+        # the reference builds its dummy CRS as a doubling chain off one
+        # random point (rs:21-52); distribution-equivalent and O(1) host
+        # work: a small pool of random multiples of G, tiled to length k
+        ks = rng.integers(1, 1 << 30, size=k)
+        host = [rm.G1.scalar_mul(G1_GENERATOR, int(x)) for x in ks[:256]]
+        reps = (k + 255) // 256
+        return C1.encode((host * reps)[:k])
+
+    def chain_g2(k):
+        ks = rng.integers(1, 1 << 30, size=k)
+        host = [rm.G2.scalar_mul(G2_GENERATOR, int(x)) for x in ks[:64]]
+        reps = (k + 63) // 64
+        return C2.encode((host * reps)[:k])
+
+    t0 = time.perf_counter()
+    s_q = chain_g1(m)
+    w_q = chain_g1(m)
+    h_q = chain_g1(m)
+    u_q = chain_g1(2 * m)
+    v_q = chain_g2(m) if args.g2 else None
+    print(json.dumps(
+        {"phase": "crs_setup", "seconds": round(time.perf_counter() - t0, 4)}
+    ))
+
+    a_share = encode_scalars_std(
+        [int.from_bytes(rng.bytes(40), "little") % R for _ in range(m)]
+    )
+    h_std = F.from_mont(h_coeff)
+
+    # --- the 5 MSMs (rs:140-152) -------------------------------------------
+    _, dt = timed("msm_s_g1_m", lambda: msm(C1, s_q, a_share)); total += dt
+    if args.g2:
+        _, dt = timed("msm_v_g2_m", lambda: msm(C2, v_q, a_share))
+        total += dt
+    _, dt = timed("msm_h_g1_m", lambda: msm(C1, h_q, a_share)); total += dt
+    _, dt = timed("msm_w_g1_m", lambda: msm(C1, w_q, a_share)); total += dt
+    _, dt = timed("msm_u_g1_2m", lambda: msm(C1, u_q, h_std[: 2 * m]))
+    total += dt
+
+    import jax
+
+    print(json.dumps({
+        "phase": "total",
+        "seconds": round(total, 3),
+        "m": m,
+        "backend": jax.default_backend(),
+        "note": "first-call timings include jit compile; rerun for steady state",
+    }))
+
+
+if __name__ == "__main__":
+    main()

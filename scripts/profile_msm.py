@@ -57,7 +57,7 @@ def main():
             acc = jnp.uint32(0)
             for i in range(k):
                 acc += lk._msm_tree_jit.__wrapped__(
-                    points, scalars ^ jnp.uint32(i), C, None
+                    g, points, scalars ^ jnp.uint32(i), C, None
                 ).sum(dtype=jnp.uint32)
             return acc
 

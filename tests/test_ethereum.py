@@ -59,8 +59,16 @@ def test_g2_c1_limb_first():
 
 def test_calldata_and_json_shapes():
     proof, _, publics = _proof_and_vk()
-    data = json.loads(solidity_calldata(proof, publics))
+    s = solidity_calldata(proof, publics)
+    # generatecall format: four bracketed groups, comma-joined, NO outer
+    # brackets — wrapping in [] must yield valid JSON with the 4 groups
+    assert not s.startswith("[[")
+    data = json.loads("[" + s + "]")
     assert len(data) == 4
     assert all(w.startswith("0x") and len(w) == 66 for w in data[0])
+    a, b, c = proof_to_eth(proof)
+    assert data[0] == [f"0x{a[0]:064x}", f"0x{a[1]:064x}"]
+    assert data[1][0] == [f"0x{b[0][0]:064x}", f"0x{b[0][1]:064x}"]
+    assert data[3] == [f"0x{v:064x}" for v in publics]
     pj = proof_to_json(proof)
     assert pj["protocol"] == "groth16" and len(pj["pi_b"]) == 3

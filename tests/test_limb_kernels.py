@@ -120,3 +120,112 @@ def test_horner_combine():
     got = C.decode(g.to_rowmajor(g.horner(s, 8)))[0]
     want = rm.G1.msm(pts, [1, 1 << 8, 1 << 16, 1 << 24])
     assert got == want
+
+
+# -- G2 / Fq2 limb path ------------------------------------------------------
+
+
+def test_limb_fq2_mul_add_sub():
+    from distributed_groth16_tpu.ops.field import fq2
+    from distributed_groth16_tpu.ops.limb_kernels import lfq2
+
+    F2 = fq2()
+    L2 = lfq2()
+    rng = np.random.default_rng(7)
+    n = 5
+    av = [(r % Q, i % Q) for r, i in zip(_rand_field(rng, n), _rand_field(rng, n))]
+    bv = [(r % Q, i % Q) for r, i in zip(_rand_field(rng, n), _rand_field(rng, n))]
+    # limb-major (32, n): rows 0-15 c0, 16-31 c1
+    enc_a = F2.encode(av)  # (n, 2, 16)
+    enc_b = F2.encode(bv)
+    a = jnp.transpose(enc_a.reshape(n, 32))
+    b = jnp.transpose(enc_b.reshape(n, 32))
+    p = jnp.asarray(L2.p_col)
+    p2 = jnp.asarray(L2.p2_col)
+    mul, add, sub = L2.make_ops(p, p2)
+    got_mul = F2.decode(
+        jnp.transpose(L2.canon_rows(mul(a, b))).reshape(n, 2, 16)
+    )
+    got_add = F2.decode(
+        jnp.transpose(L2.canon_rows(add(a, b))).reshape(n, 2, 16)
+    )
+    got_sub = F2.decode(
+        jnp.transpose(L2.canon_rows(sub(a, b))).reshape(n, 2, 16)
+    )
+    for i, (x, y) in enumerate(zip(av, bv)):
+        assert tuple(got_mul[i]) == rm.fq2_mul(x, y)
+        assert tuple(got_add[i]) == rm.fq2_add(x, y)
+        assert tuple(got_sub[i]) == rm.fq2_sub(x, y)
+
+
+def test_limb_g2_add_double_matches_curve():
+    from distributed_groth16_tpu.ops.constants import G2_GENERATOR
+    from distributed_groth16_tpu.ops.curve import g2
+    from distributed_groth16_tpu.ops.limb_kernels import lg2
+
+    C = g2()
+    g = lg2()
+    rng = np.random.default_rng(8)
+    ks = [int(x) for x in rng.integers(1, 2**60, size=3)]
+    pts = [rm.G2.scalar_mul(G2_GENERATOR, k) for k in ks]
+    qts = [rm.G2.scalar_mul(G2_GENERATOR, k + 1) for k in ks]
+    P = C.encode(pts)
+    Qp = C.encode(qts)
+    got = C.decode(g.to_rowmajor(g.add(g.from_rowmajor(P), g.from_rowmajor(Qp))))
+    want = C.decode(C.add(P, Qp))
+    assert got == want
+    got2 = C.decode(g.to_rowmajor(g.double(g.from_rowmajor(P))))
+    want2 = C.decode(C.double(P))
+    assert got2 == want2
+
+
+def test_limb_g2_infinity_cases():
+    from distributed_groth16_tpu.ops.constants import G2_GENERATOR
+    from distributed_groth16_tpu.ops.curve import g2
+    from distributed_groth16_tpu.ops.limb_kernels import lg2
+
+    C = g2()
+    g = lg2()
+    P = C.encode([rm.G2.scalar_mul(G2_GENERATOR, 99), None, G2_GENERATOR])
+    Qp = C.encode([None, G2_GENERATOR, G2_GENERATOR])
+    got = C.decode(
+        g.to_rowmajor(g.add(g.from_rowmajor(P), g.from_rowmajor(Qp)))
+    )
+    want = [
+        rm.G2.scalar_mul(G2_GENERATOR, 99),
+        G2_GENERATOR,
+        rm.G2.scalar_mul(G2_GENERATOR, 2),
+    ]
+    assert got == want
+
+
+def test_msm_tree_g2_matches_reference():
+    from distributed_groth16_tpu.ops.constants import G2_GENERATOR
+    from distributed_groth16_tpu.ops.curve import g2
+
+    C = g2()
+    rng = np.random.default_rng(9)
+    n = 37  # non-power-of-two exercises padding
+    ks = [int(x) for x in rng.integers(1, 2**61, size=n)]
+    pts = [rm.G2.scalar_mul(G2_GENERATOR, k) for k in ks]
+    scs = [int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)]
+    P = C.encode(pts)
+    sc = encode_scalars_std(scs)
+    got = C.decode(msm_tree(P, sc)[None])[0]
+    want = rm.G2.msm(pts, scs)
+    assert got == want
+
+
+def test_msm_routing_forced_g2(monkeypatch):
+    from distributed_groth16_tpu.ops.constants import G2_GENERATOR
+    from distributed_groth16_tpu.ops.curve import g2
+
+    monkeypatch.setenv("DG16_FORCE_TREE_MSM", "1")
+    C = g2()
+    rng = np.random.default_rng(10)
+    n = 16
+    ks = [int(x) for x in rng.integers(1, 2**50, size=n)]
+    pts = [rm.G2.scalar_mul(G2_GENERATOR, k) for k in ks]
+    scs = [int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)]
+    got = C.decode(msm(C, C.encode(pts), encode_scalars_std(scs))[None])[0]
+    assert got == rm.G2.msm(pts, scs)

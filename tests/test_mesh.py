@@ -21,6 +21,7 @@ from distributed_groth16_tpu.ops.field import fr
 from distributed_groth16_tpu.models.groth16.mesh_prover import (
     MeshProverInputs,
     mesh_prove,
+    mesh_prove_zk,
 )
 from distributed_groth16_tpu.parallel.mesh import make_mesh
 from distributed_groth16_tpu.parallel.pss import PackedSharingParams
@@ -64,3 +65,44 @@ def test_mesh_prover_matches_oracle():
     assert proof.a == oracle.a
     assert proof.b == oracle.b
     assert proof.c == oracle.c
+
+
+@pytest.mark.skipif(len(jax.devices()) < N, reason="needs 8 devices")
+def test_mesh_prover_zk_randomized_proof_verifies():
+    """The SPMD path must emit r,s-randomized (zero-knowledge) proofs, like
+    the async-star path (prove.rs:10-137) — and match the single-node zk
+    prover bit-exactly for the same r,s."""
+    from distributed_groth16_tpu.models.groth16.prove import prove_single
+
+    cs = mult_chain_circuit(5, 11)
+    r1cs, z = cs.finish()
+    pp = PackedSharingParams(L)
+    pk = setup(r1cs, seed=3)
+    comp = CompiledR1CS(r1cs)
+    z_mont = fr().encode(z)
+    qap_shares = comp.qap(z_mont).pss(pp)
+    crs = pack_proving_key(pk, pp)
+    ni = r1cs.num_instance
+    inp = MeshProverInputs(
+        qap_a=jnp.stack([s.a for s in qap_shares]),
+        qap_b=jnp.stack([s.b for s in qap_shares]),
+        qap_c=jnp.stack([s.c for s in qap_shares]),
+        a_share=pack_from_witness(pp, z_mont[1:]),
+        ax_share=pack_from_witness(pp, z_mont[ni:]),
+        s=jnp.stack([c.s for c in crs]),
+        u=jnp.stack([c.u for c in crs]),
+        v=jnp.stack([c.v for c in crs]),
+        w=jnp.stack([c.w for c in crs]),
+        h=jnp.stack([c.h for c in crs]),
+    )
+    mesh = make_mesh(pp.n)
+    r_rand, s_rand = 0xDEADBEEF12345, 0xC0FFEE9876
+    proof = mesh_prove_zk(pp, pk.domain_size, mesh, inp, pk, r_rand, s_rand)
+
+    assert verify(pk.vk, proof, z[1:ni])
+    oracle = prove_single(pk, comp, z_mont, r=r_rand, s=s_rand)
+    assert proof.a == oracle.a
+    assert proof.b == oracle.b
+    assert proof.c == oracle.c
+    det = prove_host(pk, r1cs, z)
+    assert proof.a != det.a  # actually randomized

@@ -189,3 +189,32 @@ def test_unpackexp_degree2():
         rm.G1.scalar_mul(G1_GENERATOR, x * y % R) for x, y in zip(xs, ys)
     ]
     assert C.decode(back) == expect
+
+
+def test_packexp_limb_ladder_matches_rowmajor(monkeypatch):
+    """The limb-major Pallas ladder path (DG16_FORCE_TREE_MSM routes it on
+    CPU too) must equal the row-major dense ladder bit-for-bit — G1 (GLV,
+    signed halves) and G2 (no GLV)."""
+    from distributed_groth16_tpu.ops.curve import g2
+    from distributed_groth16_tpu.ops.constants import G2_GENERATOR
+
+    l = 2
+    pp = PackedSharingParams(l)
+    rng = random.Random(99)
+    ks = [rng.randrange(1, R) for _ in range(l)]
+
+    C = g1()
+    pts1 = C.encode([rm.G1.scalar_mul(G1_GENERATOR, k) for k in ks])
+    C2 = g2()
+    pts2 = C2.encode([rm.G2.scalar_mul(G2_GENERATOR, k) for k in ks])
+
+    base = pp.packexp_from_public(C, pts1, method="dense")
+    base2 = pp.packexp_from_public(C2, pts2, method="dense")
+    monkeypatch.setenv("DG16_FORCE_TREE_MSM", "1")
+    fast = pp.packexp_from_public(C, pts1, method="dense")
+    fast2 = pp.packexp_from_public(C2, pts2, method="dense")
+    assert C.decode(fast) == C.decode(base)
+    assert C2.decode(fast2) == C2.decode(base2)
+    # and unpacking the fast-packed shares returns the originals
+    back = pp.unpackexp(C, fast, method="dense")
+    assert C.decode(back) == C.decode(pts1)
