@@ -289,6 +289,16 @@ class PackedSharingParams:
         return self._apply_point_matrix(curve, which, shares)
 
     def _pick_exp_method(self, method: str) -> str:
+        if self.modulus != R:
+            # pointntt's domains/twiddles are built over BN254 Fr; the
+            # dense matrix ladder is the only in-exponent path for other
+            # scalar fields
+            if method == "ntt":
+                raise NotImplementedError(
+                    "in-exponent point-NTT is BN254-Fr-only; use the "
+                    "dense ladder for this scalar field"
+                )
+            return "dense"
         if method == "auto":
             return "ntt" if self.n >= self._NTT_THRESHOLD else "dense"
         assert method in ("dense", "ntt")

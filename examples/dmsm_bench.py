@@ -4,10 +4,10 @@ plain local MSM, swept over sizes 2^10..2^19 (reference loop,
 dmsm_bench.rs:42-50).
 
 Run: python examples/dmsm_bench.py [--min 10] [--max 19] [--l 2]
-     python examples/dmsm_bench.py --curve bls12-377 --local-only
-(The reference's dmsm_bench runs over BLS12-377 — dmsm_bench.rs:1,48;
---curve bls12-377 benches the local MSM on that curve. The distributed
-path's PSS domains are BN254-Fr, so d_msm stays BN254 for now.)
+     python examples/dmsm_bench.py --curve bls12-377
+(--curve bls12-377 runs the reference's exact configuration — d_msm over
+BLS12-377 with packed sharing over Fr377, dmsm_bench.rs:1,48 — for both
+the local and the distributed sweep.)
 """
 
 from __future__ import annotations
@@ -43,22 +43,33 @@ def main() -> int:
     from distributed_groth16_tpu.parallel.pss import PackedSharingParams
 
     if args.curve == "bls12-377":
+        # the reference's own configuration: d_msm over BLS12-377
+        # (dmsm_bench.rs:1,48) with PSS over Fr377
         from distributed_groth16_tpu.ops.bls12_377 import (
             R377,
             encode_scalars_377,
+            fr377,
             g1_377,
             g1_generator_377,
+            pack_scalars_377,
+            pss377,
         )
 
-        if not args.local_only:
-            p.error("--curve bls12-377 requires --local-only")
         C, gen, r_mod = g1_377(), g1_generator_377(), R377
         enc = encode_scalars_377
+        sf = fr377()
+        pp = pss377(args.l)
+
+        def pack_scalar_shares(scalars_int):
+            return pack_scalars_377(pp, scalars_int)
     else:
         C, gen, r_mod = g1(), G1_GENERATOR, R
         enc = encode_scalars_std
-    F = fr()
-    pp = PackedSharingParams(args.l)
+        sf = fr()
+        pp = PackedSharingParams(args.l)
+
+        def pack_scalar_shares(scalars_int):
+            return pack_consecutive(pp, fr().encode(scalars_int))
     rng = np.random.default_rng(0)
     nl = C.elem_shape[0]
 
@@ -81,14 +92,14 @@ def main() -> int:
 
         if not args.local_only:
             # distributed MSM (dmsm_bench.rs role)
-            s_shares = pack_consecutive(pp, F.encode(scalars_int))
-            base_chunks = points.reshape(n // pp.l, pp.l, 3, 16)
+            s_shares = pack_scalar_shares(scalars_int)
+            base_chunks = points.reshape(n // pp.l, pp.l, 3, nl)
             b_shares = jnp.swapaxes(
                 pp.packexp_from_public(C, base_chunks), 0, 1
             )
 
             async def party(net, d):
-                return await d_msm(C, d[0], d[1], pp, net)
+                return await d_msm(C, d[0], d[1], pp, net, scalar_field=sf)
 
             data = [(b_shares[i], s_shares[i]) for i in range(pp.n)]
             t0 = time.perf_counter()

@@ -30,9 +30,8 @@ def main() -> None:
     ap.add_argument("--log2-m", type=int, default=15)
     ap.add_argument("--l", type=int, default=2,
                     help="packing parameter (memory accounting only)")
-    ap.add_argument("--g2", action="store_true", default=True)
     ap.add_argument("--no-g2", dest="g2", action="store_false",
-                    help="skip the V·G2 MSM (fast smoke runs)")
+                    default=True, help="skip the V·G2 MSM (fast smoke runs)")
     args = ap.parse_args()
 
     import jax.numpy as jnp
