@@ -26,6 +26,7 @@ plain `Domain.fft` ground truth, mirroring local_dfft_test.rs.
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,8 @@ from ..ops.field import fr
 from ..ops.ntt import bitrev_perm, domain
 from .net import Net
 from .pss import PackedSharingParams
+
+log = logging.getLogger(__name__)
 
 
 @functools.partial(jax.jit, static_argnames=("logm", "logl", "inverse"))
@@ -177,6 +180,8 @@ async def _d_transform(
     logl = pp.l.bit_length() - 1
     wpows = domain(m)._wpows
     F = fr()
+    log.debug("d_%sfft: party %d stage-1 m=%d (sid=%d)",
+              "i" if inverse else "", net.party_id, m, sid)
     if inverse:
         share_vec = F.mul(share_vec, dom._size_inv)
     local = _fft1_local(share_vec, wpows, logm, logl, inverse)

@@ -12,11 +12,15 @@ Communication: O(1) group elements per party — d_msm is compute-bound.
 
 from __future__ import annotations
 
+import logging
+
 from ..ops.curve import CurvePoints
 from ..ops.field import fr
 from ..ops.msm import msm
 from .net import Net
 from .pss import PackedSharingParams
+
+log = logging.getLogger(__name__)
 
 
 async def d_msm(
@@ -37,6 +41,8 @@ async def d_msm(
     reference's BLS12-377 configuration (dmsm_bench.rs:42-50; d_msm itself
     is curve-generic there, dmsm/mod.rs:70)."""
     F = scalar_field or fr()
+    log.debug("d_msm: party %d local MSM over %d bases (sid=%d)",
+              net.party_id, bases.shape[0], sid)
     local = msm(curve, bases, F.from_mont(scalar_shares))
 
     def king(points):

@@ -35,7 +35,13 @@ Backends:
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Any, Awaitable, Callable, Protocol, Sequence
+
+# module-level tracing, the role of the reference's log/env_logger calls
+# throughout mpc-net (multi.rs:149,:182); enable with
+# logging.getLogger("distributed_groth16_tpu").setLevel(logging.DEBUG)
+log = logging.getLogger(__name__)
 
 CHANNELS = 3
 
@@ -83,12 +89,16 @@ class BaseNet:
         """King returns [v_0, ..., v_{n-1}] (own value at index 0);
         clients send and return None."""
         if self.is_king:
+            log.debug("gather_to_king: king collecting %d values (sid=%d)",
+                      self.n_parties, sid)
             out = [value]
             recvs = [
                 self.recv_from(i, sid) for i in range(1, self.n_parties)
             ]
             out.extend(await asyncio.gather(*recvs))
             return out
+        log.debug("gather_to_king: party %d sending (sid=%d)",
+                  self.party_id, sid)
         await self.send_to(0, value, sid)
         return None
 
@@ -103,6 +113,8 @@ class BaseNet:
                     f"scatter_from_king: {len(values)} values for "
                     f"{self.n_parties} parties"
                 )
+            log.debug("scatter_from_king: king fanning out %d values "
+                      "(sid=%d)", len(values), sid)
             sends = [
                 self.send_to(i, values[i], sid)
                 for i in range(1, self.n_parties)

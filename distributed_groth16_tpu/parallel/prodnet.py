@@ -28,6 +28,7 @@ device arrays cross the wire as raw limb buffers.
 from __future__ import annotations
 
 import asyncio
+import logging
 import ssl
 import struct
 from typing import Any
@@ -37,6 +38,10 @@ import numpy as np
 
 from ..utils import serde
 from .net import CHANNELS, BaseNet, MpcNetError
+
+# connection-lifecycle tracing (the reference's env_logger role,
+# mpc-net/src/prod.rs); enable via the "distributed_groth16_tpu" logger
+log = logging.getLogger(__name__)
 
 SYN, SYNACK, DATA = 0, 1, 2
 
@@ -154,6 +159,8 @@ class ProdNet(BaseNet):
                 await io.close()
                 return
             accepted[cid] = io
+            log.debug("king: accepted party %d (%d/%d)", cid,
+                      len(accepted), n_parties - 1)
             if len(accepted) == n_parties - 1:
                 done.set()
 
@@ -241,7 +248,9 @@ class ProdNet(BaseNet):
                 await q.put((ptype, payload))
         except asyncio.CancelledError:
             raise
-        except Exception:  # noqa: BLE001 — death sentinel on every failure
+        except Exception as e:  # noqa: BLE001 — death sentinel on every failure
+            log.warning("party %d: stream to peer %d died: %s",
+                        self.party_id, peer, e)
             self._dead.add(peer)
             for sid in range(CHANNELS):
                 self._queues[(peer, sid)].put_nowait((None, b"Stream died"))

@@ -72,3 +72,26 @@ def test_read_wtns():
 def test_witness_calculator_rejects_non_wasm():
     with pytest.raises(AssertionError, match="wasm magic"):
         WitnessCalculator(b"not a wasm module")
+
+
+def test_circom_builder_facade():
+    """CircomConfig/CircomBuilder one-call flow vs the real mycircuit
+    artifacts (builder.rs:20-97): push inputs, build, the witness
+    satisfies the compiled R1CS and exposes the expected public input."""
+    if not os.path.exists(f"{VECTORS}/mycircuit.wasm"):
+        pytest.skip("no fixture")
+    from distributed_groth16_tpu.frontend.builder import (
+        CircomBuilder,
+        CircomConfig,
+    )
+
+    cfg = CircomConfig(f"{VECTORS}/mycircuit.wasm",
+                       f"{VECTORS}/mycircuit.r1cs", sanity_check=True)
+    b = CircomBuilder(cfg)
+    b.push_input("a", 3)
+    b.push_input("b", 11)
+    circuit = b.build()
+    assert circuit.r1cs.is_satisfied(circuit.witness)
+    assert circuit.public_inputs() == [33]  # mycircuit: c = a*b
+    empty = b.setup()
+    assert empty.witness is None
