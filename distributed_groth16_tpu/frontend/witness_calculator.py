@@ -61,9 +61,29 @@ def _host_funcs(collector):
 
 
 class WitnessCalculator:
-    def __init__(self, wasm_bytes: bytes):
+    def __init__(self, wasm_bytes: bytes, engine: str = "auto"):
+        """engine: "auto" (C tier when buildable — ~100x the throughput of
+        the Python VM — else Python), "c", or "python". DG16_NO_CWASM=1
+        forces the Python VM globally."""
+        import os
+
         self.module = Module(wasm_bytes)
-        self.inst = Instance(self.module, _host_funcs(self))
+        use_c = engine == "c" or (
+            engine == "auto" and not os.environ.get("DG16_NO_CWASM")
+        )
+        self.inst = None
+        self._auto = engine == "auto"
+        if use_c:
+            try:
+                from .wasm_cexec import CInstance
+
+                self.inst = CInstance(self.module, _host_funcs(self))
+            except ImportError:
+                if engine == "c":
+                    raise
+        if self.inst is None:
+            self.inst = Instance(self.module, _host_funcs(self))
+            self._auto = False
         try:
             self.version = self.inst.call("getVersion")[0]
         except KeyError:
@@ -131,10 +151,25 @@ class WitnessCalculator:
 
     def calculate_witness(self, inputs: dict, sanity_check: bool = False):
         """inputs: {signal name: int | list[int]} -> list of witness ints."""
-        self.inst.call("init", [1 if sanity_check else 0])
-        if self.version >= 2:
-            return self._calculate_circom2(inputs)
-        return self._calculate_circom1(inputs)
+        try:
+            self.inst.call("init", [1 if sanity_check else 0])
+            if self.version >= 2:
+                return self._calculate_circom2(inputs)
+            return self._calculate_circom1(inputs)
+        except Exception as e:
+            from .wasm_cexec import WasmMemoryLimit
+
+            # the C tier caps linear memory; the Python VM grows without
+            # bound — retry there so huge circuits keep working under the
+            # default engine. Any other trap re-raises as-is.
+            if not (self._auto and isinstance(e, WasmMemoryLimit)):
+                raise
+            self.inst = Instance(self.module, _host_funcs(self))
+            self._auto = False
+            self.inst.call("init", [1 if sanity_check else 0])
+            if self.version >= 2:
+                return self._calculate_circom2(inputs)
+            return self._calculate_circom1(inputs)
 
     def _values(self, v):
         if isinstance(v, (list, tuple)):
