@@ -26,7 +26,7 @@
 
 #define PAGE 65536
 #define VALUE_STACK_CAP (1 << 20)
-#define FRAME_CAP 4096
+#define FRAME_POOL_CAP (1 << 20) /* shared heap pool, not per-call C stack */
 #define CALL_DEPTH_CAP 8192
 
 /* trap codes (mirrored in wasm_cexec.py) */
@@ -77,6 +77,12 @@ typedef struct {
     jmp_buf trap_jmp;
     int32_t trap_code;
     int64_t call_depth;
+    /* control-frame pool shared across the call chain: a per-call
+     * stack-allocated array was 128KB of C stack per recursion level,
+     * exhausting the thread stack (SIGSEGV) long before CALL_DEPTH_CAP
+     * could trap */
+    void *frames; /* Frame[FRAME_POOL_CAP] */
+    int64_t frame_base;
 } Engine;
 
 static void trap(Engine *E, int code) {
@@ -124,11 +130,14 @@ typedef struct {
 
 /* bounds-checked memory access: the engine executes UNTRUSTED modules
  * (the API server runs client-uploaded witness generators), so every
- * load/store validates addr+width against the CURRENT memory size. */
+ * load/store validates addr+width against the CURRENT memory size —
+ * overflow-safely: `a_ + width` can wrap at 2^64 for a hostile address,
+ * so compare against size - width instead. */
 #define MEMADDR(E, addr, width)                                              \
     ({                                                                       \
         uint64_t a_ = (addr);                                                \
-        if (a_ + (width) > (uint64_t)(*(E)->cur_pages) * PAGE)               \
+        uint64_t msz_ = (uint64_t)(*(E)->cur_pages) * PAGE;                  \
+        if (msz_ < (width) || a_ > msz_ - (width))                           \
             trap((E), WX_TRAP_OOB);                                          \
         (E)->memory + a_;                                                    \
     })
@@ -138,16 +147,19 @@ static void exec_func(Engine *E, int64_t lf, int64_t base) {
     const int64_t ncode = E->func_off[lf + 1] - E->func_off[lf];
     const int64_t nloc = E->func_nparams[lf] + E->func_locals[lf];
     const int64_t nres = E->func_nresults[lf];
-    /* capacity check BEFORE touching the locals region: memset past the
-     * stack would corrupt the heap instead of trapping */
-    if (base + nloc + 4096 > VALUE_STACK_CAP) trap(E, WX_TRAP_STACK);
+    /* capacity check BEFORE touching the locals region, with headroom for
+     * the WHOLE body: net stack growth is bounded by the instruction
+     * count (each instruction pushes at most one value), so an untrusted
+     * body can never run sp past the cap between checks */
+    if (base + nloc + ncode + 8 > VALUE_STACK_CAP) trap(E, WX_TRAP_STACK);
     uint64_t *loc = E->vstack + base;
     /* zero the non-param locals; value stack begins after the locals */
     memset(loc + E->func_nparams[lf], 0,
            (size_t)E->func_locals[lf] * sizeof(uint64_t));
     int64_t sp = base + nloc; /* absolute index into vstack */
     uint64_t *st = E->vstack;
-    Frame frames[FRAME_CAP];
+    const int64_t fb = E->frame_base;
+    Frame *frames = (Frame *)E->frames + fb;
     int64_t nf = 0;
     int64_t pc = 0;
 
@@ -173,16 +185,16 @@ static void exec_func(Engine *E, int64_t lf, int64_t base) {
         case 0x7C: { uint64_t v = st[--sp];
                      st[sp-1] = st[sp-1] + v; break; }     /* i64.add */
         case 0x02: /* block */
-            if (nf >= FRAME_CAP) trap(E, WX_TRAP_STACK);
+            if (fb + nf >= FRAME_POOL_CAP) trap(E, WX_TRAP_STACK);
             frames[nf++] = (Frame){0, I->b + 1, sp, I->a};
             break;
         case 0x03: /* loop */
-            if (nf >= FRAME_CAP) trap(E, WX_TRAP_STACK);
+            if (fb + nf >= FRAME_POOL_CAP) trap(E, WX_TRAP_STACK);
             frames[nf++] = (Frame){1, pc, sp, 0};
             break;
         case 0x04: { /* if: a=arity, b=end_pc, c=else_pc */
             uint64_t cond = st[--sp];
-            if (nf >= FRAME_CAP) trap(E, WX_TRAP_STACK);
+            if (fb + nf >= FRAME_POOL_CAP) trap(E, WX_TRAP_STACK);
             frames[nf++] = (Frame){0, I->b + 1, sp, I->a};
             if (!cond) pc = (I->c != -1) ? I->c : I->b;
             break; }
@@ -215,12 +227,18 @@ static void exec_func(Engine *E, int64_t lf, int64_t base) {
             }
             break; }
         case 0x0F: goto func_return; /* return */
-        case 0x10: sp = do_call(E, I->a, sp); break; /* call */
+        case 0x10: /* call */
+            E->frame_base = fb + nf;
+            sp = do_call(E, I->a, sp);
+            E->frame_base = fb;
+            break;
         case 0x11: { /* call_indirect: a = type idx */
             uint64_t k = st[--sp];
             if (k >= (uint64_t)E->ntable || E->table[k] < 0)
                 trap(E, WX_TRAP_BAD_TABLE);
+            E->frame_base = fb + nf;
             sp = do_call(E, E->table[k], sp);
+            E->frame_base = fb;
             break; }
         case 0x1A: sp--; break; /* drop */
         case 0x1B: { uint64_t c = st[--sp], b2 = st[--sp];
@@ -423,7 +441,8 @@ Engine *wx_new(const int64_t *ins_flat, int64_t n_ins,
     E->nfuncs = nfuncs;
     E->host = host;
     E->vstack = (uint64_t *)malloc(VALUE_STACK_CAP * sizeof(uint64_t));
-    if (!E->vstack) { free(E); return NULL; }
+    E->frames = malloc(FRAME_POOL_CAP * sizeof(Frame));
+    if (!E->vstack || !E->frames) { free(E->vstack); free(E->frames); free(E); return NULL; }
     return E;
 }
 
@@ -440,6 +459,7 @@ void wx_free(Engine *E) {
     free((void *)E->imp_nresults);
     free((void *)E->br_pool);
     free(E->vstack);
+    free(E->frames);
     free(E);
 }
 
@@ -449,6 +469,7 @@ int32_t wx_call(Engine *E, int64_t fi, const uint64_t *args, int32_t nargs,
                 uint64_t *results, int32_t *nresults) {
     E->trap_code = WX_OK;
     E->call_depth = 0;
+    E->frame_base = 0;
     if (setjmp(E->trap_jmp)) return E->trap_code;
     int64_t lf = fi - E->n_imports;
     if (lf < 0 || lf >= E->nfuncs) return WX_TRAP_BAD_TABLE;

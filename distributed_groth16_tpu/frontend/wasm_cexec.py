@@ -113,12 +113,28 @@ def load_engine():
 
 
 def _serialize(m: Module):
-    """Module -> the flat int64 arrays the C engine consumes."""
+    """Module -> the flat int64 arrays the C engine consumes.
+
+    Validates every module-supplied index here, at load time: the C
+    executor trusts local/global/call indices (the Python VM's IndexError
+    safety net doesn't exist there), and the module may be
+    client-uploaded."""
     ins_rows = []
     func_off = [0]
     br_pool = []
+    nglobals = len(m.globals_init)
+    nfuncs_total = len(m.func_imports) + len(m.functions)
     for fn in m.functions:
+        nloc = len(m.types[fn.type_idx].params) + fn.locals_n
         for op, a, b, c in fn.code:
+            if op in (0x20, 0x21, 0x22) and not 0 <= a < nloc:
+                raise WasmTrap(f"local index {a} out of range")
+            if op in (0x23, 0x24) and not 0 <= a < nglobals:
+                raise WasmTrap(f"global index {a} out of range")
+            if op == 0x10 and not 0 <= a < nfuncs_total:
+                raise WasmTrap(f"call target {a} out of range")
+            if op == 0x11 and not 0 <= a < len(m.types):
+                raise WasmTrap(f"call_indirect type {a} out of range")
             if op == 0x0E:  # br_table: a=targets list, b=default
                 ins_rows.append((op, len(br_pool), len(a), b))
                 br_pool.extend(a)

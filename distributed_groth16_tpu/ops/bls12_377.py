@@ -42,91 +42,21 @@ G1_COFACTOR = (X - 1) ** 2 // 3
 FR_TWO_ADICITY_377 = ((R377 - 1) & -(R377 - 1)).bit_length() - 1  # = 47
 
 
-def _is_probable_prime(n: int, rounds: int = 40) -> bool:
-    """Deterministic-enough Miller-Rabin (fixed small bases + pseudorandom)."""
-    if n < 2:
-        return False
-    for sp in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
-        if n % sp == 0:
-            return n == sp
-    d, s = n - 1, 0
-    while d % 2 == 0:
-        d //= 2
-        s += 1
-    import random
-
-    rng = random.Random(0xB15B377)
-    for i in range(rounds):
-        a = (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37)[i] if i < 12 else (
-            rng.randrange(2, n - 1)
-        )
-        x = pow(a, d, n)
-        if x in (1, n - 1):
-            continue
-        for _ in range(s - 1):
-            x = x * x % n
-            if x == n - 1:
-                break
-        else:
-            return False
-    return True
-
-
-def _pollard_rho(n: int) -> int:
-    """One nontrivial factor of composite n (Brent's variant)."""
-    import math
-    import random
-
-    if n % 2 == 0:
-        return 2
-    rng = random.Random(n)
-    while True:
-        y, c, m = rng.randrange(1, n), rng.randrange(1, n), 128
-        g, r, q = 1, 1, 1
-        while g == 1:
-            x = y
-            for _ in range(r):
-                y = (y * y + c) % n
-            k = 0
-            while k < r and g == 1:
-                ys = y
-                for _ in range(min(m, r - k)):
-                    y = (y * y + c) % n
-                    q = q * abs(x - y) % n
-                g = math.gcd(q, n)
-                k += m
-            r <<= 1
-        if g == n:
-            g = 1
-            while g == 1:
-                ys = (ys * ys + c) % n
-                g = math.gcd(abs(x - ys), n)
-        if g != n:
-            return g
-
-
-def _factor(n: int) -> set[int]:
-    """Prime factors of n (recursive rho; n here has <= 64-bit parts)."""
-    if n == 1:
-        return set()
-    if _is_probable_prime(n):
-        return {n}
-    d = _pollard_rho(n)
-    return _factor(d) | _factor(n // d)
+from .primemath import (
+    factor as _factor,
+    is_probable_prime as _is_probable_prime,
+    smallest_generator,
+    sqrt_mod,
+)
 
 
 @functools.cache
 def _fr_generator() -> int:
-    """Smallest multiplicative generator of Fr377 (arkworks convention:
-    smallest g whose order is r-1). r-1 = x^2 (x-1)(x+1) factors through
-    64-bit integers."""
-    primes = _factor(X) | _factor(X - 1) | _factor(X + 1)
-    phi = R377 - 1
-    g = 2
-    while True:
-        if all(pow(g, phi // p, R377) != 1 for p in primes):
-            return g
-        g += 1
+    """Smallest multiplicative generator of Fr377 (arkworks convention).
+    r-1 = x^2 (x-1)(x+1) factors through 64-bit integers."""
+    return smallest_generator(
+        R377, _factor(X) | _factor(X - 1) | _factor(X + 1)
+    )
 
 
 # --------------------------------------------------------------------------
@@ -162,29 +92,8 @@ G1_HOST = rm._CurveOps(
 
 
 def _sqrt_fq(a: int) -> int | None:
-    """Square root in Fq377 (q ≡ 1 mod 4 — Tonelli-Shanks, two-adicity 46)."""
-    if a == 0:
-        return 0
-    if pow(a, (Q377 - 1) // 2, Q377) == Q377 - 1:
-        return None  # non-residue
-    # Tonelli-Shanks
-    s = ((Q377 - 1) & -(Q377 - 1)).bit_length() - 1
-    qodd = (Q377 - 1) >> s
-    # any quadratic non-residue works as the generator
-    z = 2
-    while pow(z, (Q377 - 1) // 2, Q377) != Q377 - 1:
-        z += 1
-    m, c = s, pow(z, qodd, Q377)
-    t, r = pow(a, qodd, Q377), pow(a, (qodd + 1) // 2, Q377)
-    while t != 1:
-        t2, i = t, 0
-        while t2 != 1:
-            t2 = t2 * t2 % Q377
-            i += 1
-        b = pow(c, 1 << (m - i - 1), Q377)
-        m, c = i, b * b % Q377
-        t, r = t * c % Q377, r * b % Q377
-    return r
+    """Square root in Fq377 (Tonelli-Shanks via primemath.sqrt_mod)."""
+    return sqrt_mod(a, Q377)
 
 
 @functools.cache

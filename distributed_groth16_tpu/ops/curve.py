@@ -101,9 +101,14 @@ class CurvePoints:
         # arr has shape batch + (3,) [+ (2,)]
         flat = arr.reshape((-1, 3) + ((2,) if self.coord_axes == 2 else ()))
         out = []
-        from .refmath import finv, fq2_inv, fq2_mul
+        from .refmath import finv
 
-        p_mod = self.F.p if hasattr(self.F, "p") else Q  # curve's own modulus
+        # the curve's OWN base modulus (refmath's fq2_* are BN254-bound, so
+        # the Fq2 normalization below is done locally mod p_mod — decoding
+        # a BLS12-381 G2 point through BN254 ops silently garbled it)
+        p_mod = self.F.p if hasattr(self.F, "p") else self.F.fq.p
+        from .primemath import fq2_inv as f2inv, fq2_mul as f2mul
+
         for row in flat:
             if self.coord_axes == 1:
                 x, y, z = int(row[0]), int(row[1]), int(row[2])
@@ -119,8 +124,8 @@ class CurvePoints:
                 if z == (0, 0):
                     out.append(None)
                 else:
-                    zi = fq2_inv(z)
-                    out.append((fq2_mul(x, zi), fq2_mul(y, zi)))
+                    zi = f2inv(z, p_mod)
+                    out.append((f2mul(x, zi, p_mod), f2mul(y, zi, p_mod)))
         if batch == ():
             return out[0]
         if len(batch) == 1:
@@ -374,7 +379,15 @@ def fixed_scalar_ladder_tensors(curve: CurvePoints, scalars):
     part 0 = k1 on P, part 1 = k2 on phi(P). Without GLV: bits
     (1, S, nbits=256), signs None.
     """
-    from .msm import encode_scalars_std
+    from .constants import to_limbs
+
+    def raw_limbs(vals):
+        # NOT encode_scalars_std: that reduces mod BN254 Fr, which silently
+        # corrupts scalars of a larger-order curve (r381 is 255-bit). The
+        # values here are already reduced mod curve.r.
+        return jnp.asarray(
+            np.array([to_limbs(v) for v in vals], dtype=np.uint32)
+        )
 
     s = [v % curve.r for v in scalars]
     n = len(s)
@@ -383,10 +396,10 @@ def fixed_scalar_ladder_tensors(curve: CurvePoints, scalars):
         halves = [curve.glv.decompose(v) for v in s]
         flat = [abs(h[p]) for p in (0, 1) for h in halves]
         sgn = [h[p] < 0 for p in (0, 1) for h in halves]
-        bits = scalar_bits(encode_scalars_std(flat), nbits).reshape(2, n, nbits)
+        bits = scalar_bits(raw_limbs(flat), nbits).reshape(2, n, nbits)
         signs = jnp.asarray(np.array(sgn, dtype=bool).reshape(2, n))
         return bits, signs, nbits
-    bits = scalar_bits(encode_scalars_std(s), 256).reshape(1, n, 256)
+    bits = scalar_bits(raw_limbs(s), 256).reshape(1, n, 256)
     return bits, None, 256
 
 

@@ -348,10 +348,11 @@ class Fq2Ops:
         return jnp.all(a == b, axis=(-1, -2))
 
     def consts(self, shape=()):
-        z = jnp.broadcast_to(jnp.asarray(self.fq.zero), shape + (2, N_LIMBS))
-        one = np.zeros((2, N_LIMBS), np.uint32)
+        nl = self.fq.nl  # limb count follows the base field (24 for BLS)
+        z = jnp.broadcast_to(jnp.asarray(self.fq.zero), shape + (2, nl))
+        one = np.zeros((2, nl), np.uint32)
         one[0] = self.fq.one
-        o = jnp.broadcast_to(jnp.asarray(one), shape + (2, N_LIMBS))
+        o = jnp.broadcast_to(jnp.asarray(one), shape + (2, nl))
         return z, o
 
 

@@ -26,8 +26,20 @@ def main() -> int:
     p.add_argument("--max", type=int, default=19)
     p.add_argument("--l", type=int, default=2)
     p.add_argument("--local-only", action="store_true")
-    p.add_argument("--curve", choices=("bn254", "bls12-377"), default="bn254")
+    p.add_argument(
+        "--curve",
+        choices=("bn254", "bls12-377", "bls12-381"),
+        default="bn254",
+    )
+    p.add_argument(
+        "--g2",
+        action="store_true",
+        help="bls12-381 only: sweep the G2 MSM instead of G1 "
+        "(BASELINE config 5 is G1/G2 at 2^24)",
+    )
     args = p.parse_args()
+    if args.g2 and args.curve != "bls12-381":
+        p.error("--g2 requires --curve bls12-381")
 
     import jax
     import jax.numpy as jnp
@@ -62,6 +74,35 @@ def main() -> int:
 
         def pack_scalar_shares(scalars_int):
             return pack_scalars_377(pp, scalars_int)
+    elif args.curve == "bls12-381":
+        # BASELINE config 5's curve: G1/G2 MSM with packed sharing over
+        # r381 (2^24 is the target size on the chip; sweep what fits)
+        from distributed_groth16_tpu.ops.bls12_381 import (
+            R381,
+            encode_scalars_381,
+            fr381,
+            g1_381,
+            g1_generator_381,
+            g2_381,
+            g2_generator_381,
+            pss381,
+        )
+
+        if args.g2:
+            C, gen = g2_381(), g2_generator_381()
+        else:
+            C, gen = g1_381(), g1_generator_381()
+        r_mod = R381
+        enc = encode_scalars_381
+        sf = fr381()
+        pp = pss381(args.l)
+
+        def pack_scalar_shares(scalars_int):
+            from distributed_groth16_tpu.ops.bls12_381 import (
+                pack_scalars_381,
+            )
+
+            return pack_scalars_381(pp, scalars_int)
     else:
         C, gen, r_mod = g1(), G1_GENERATOR, R
         enc = encode_scalars_std
@@ -71,14 +112,14 @@ def main() -> int:
         def pack_scalar_shares(scalars_int):
             return pack_consecutive(pp, fr().encode(scalars_int))
     rng = np.random.default_rng(0)
-    nl = C.elem_shape[0]
+    pt_shape = (3,) + C.elem_shape
 
     for logn in range(args.min, args.max + 1):
         n = 1 << logn
         scalars_int = [
             int.from_bytes(rng.bytes(40), "little") % r_mod for _ in range(n)
         ]
-        points = jnp.broadcast_to(C.encode([gen])[0], (n, 3, nl))
+        points = jnp.broadcast_to(C.encode([gen])[0], (n,) + pt_shape)
 
         # local MSM (msm_bench.rs role)
         std = enc(scalars_int)
@@ -93,7 +134,7 @@ def main() -> int:
         if not args.local_only:
             # distributed MSM (dmsm_bench.rs role)
             s_shares = pack_scalar_shares(scalars_int)
-            base_chunks = points.reshape(n // pp.l, pp.l, 3, nl)
+            base_chunks = points.reshape((n // pp.l, pp.l) + pt_shape)
             b_shares = jnp.swapaxes(
                 pp.packexp_from_public(C, base_chunks), 0, 1
             )

@@ -1,0 +1,182 @@
+"""BLS12-381 (BASELINE config 5's curve): derived parameters, device
+G1/G2 arithmetic and MSM vs host bigint ground truth, Fr381 NTT domains,
+and packed sharing over r381."""
+
+import numpy as np
+import pytest
+
+from distributed_groth16_tpu.ops import refmath as rm
+from distributed_groth16_tpu.ops.bls12_381 import (
+    FR_TWO_ADICITY_381,
+    G1_HOST,
+    G2_HOST,
+    Q381,
+    R381,
+    _fr_generator,
+    encode_scalars_381,
+    g1_381,
+    g1_generator_381,
+    g2_381,
+    g2_generator_381,
+    pss381,
+)
+from distributed_groth16_tpu.ops.msm import msm
+
+
+def test_params_match_published_bls12_381():
+    """The seed-derived constants equal the published BLS12-381 values —
+    an external differential on the whole derivation."""
+    # canonical published values, in hex to avoid transcription slips
+    assert R381 == int(
+        "73eda753299d7d483339d80809a1d80553bda402fffe5bfeffffffff00000001",
+        16,
+    )
+    assert Q381 == int(
+        "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+        "1eabfffeb153ffffb9feffffffffaaab",
+        16,
+    )
+    assert _fr_generator() == 7  # arkworks Fr::GENERATOR
+    assert FR_TWO_ADICITY_381 == 32
+
+
+def test_g1_generator_matches_standard():
+    gx, gy = g1_generator_381()
+    # the ceremony/spec generator (draft-irtf-cfrg-pairing-friendly-curves)
+    assert gx == int(
+        "36854167537133870167810883151830777579616207957825464098945783786"
+        "88607592378376318836054947676345821548104185464507"
+    )
+    assert G1_HOST.is_on_curve((gx, gy))
+
+
+def test_device_g1_matches_host():
+    C = g1_381()
+    gen = g1_generator_381()
+    rng = np.random.default_rng(0)
+    ks = [int(x) for x in rng.integers(1, 2**60, size=3)]
+    pts = [G1_HOST.scalar_mul(gen, k) for k in ks]
+    qts = [G1_HOST.scalar_mul(gen, k + 5) for k in ks]
+    P, Qp = C.encode(pts), C.encode(qts)
+    assert C.decode(C.add(P, Qp)) == [
+        G1_HOST.add(a, b) for a, b in zip(pts, qts)
+    ]
+    assert C.decode(C.double(P)) == [G1_HOST.double(p) for p in pts]
+
+
+def test_device_g2_matches_host():
+    C = g2_381()
+    gen = g2_generator_381()
+    rng = np.random.default_rng(1)
+    ks = [int(x) for x in rng.integers(1, 2**60, size=2)]
+    pts = [G2_HOST.scalar_mul(gen, k) for k in ks]
+    qts = [G2_HOST.scalar_mul(gen, k + 3) for k in ks]
+    P, Qp = C.encode(pts), C.encode(qts)
+    assert C.decode(C.add(P, Qp)) == [
+        G2_HOST.add(a, b) for a, b in zip(pts, qts)
+    ]
+    assert C.decode(C.double(P)) == [G2_HOST.double(p) for p in pts]
+
+
+def test_msm_g1_and_g2_match_host():
+    rng = np.random.default_rng(2)
+    n = 16
+    scal = [int.from_bytes(rng.bytes(40), "little") % R381 for _ in range(n)]
+    sc = encode_scalars_381(scal)
+
+    C1, gen1 = g1_381(), g1_generator_381()
+    pts1 = [G1_HOST.scalar_mul(gen1, k + 1) for k in range(n)]
+    assert C1.decode(msm(C1, C1.encode(pts1), sc)[None])[0] == G1_HOST.msm(
+        pts1, scal
+    )
+
+    C2, gen2 = g2_381(), g2_generator_381()
+    pts2 = [G2_HOST.scalar_mul(gen2, k + 1) for k in range(n)]
+    assert C2.decode(msm(C2, C2.encode(pts2), sc)[None])[0] == G2_HOST.msm(
+        pts2, scal
+    )
+
+
+def test_fr381_domain_roundtrip():
+    """rm.Domain generalization carries r381: fft/ifft roundtrip + coset."""
+    import random
+
+    rng = random.Random(3)
+    n = 32
+    gen = _fr_generator()
+    dom = rm.Domain(n, modulus=R381, generator=gen)
+    xs = [rng.randrange(R381) for _ in range(n)]
+    assert dom.ifft(dom.fft(xs)) == xs
+    coset = dom.get_coset(gen)
+    assert coset.ifft(coset.fft(xs)) == xs
+
+
+def test_pss381_in_exponent_roundtrip():
+    """Pack G1-381 points in the exponent over r381 shares and unpack."""
+    import random
+
+    rng = random.Random(4)
+    l = 2
+    pp = pss381(l)
+    C = g1_381()
+    gen = g1_generator_381()
+    ks = [rng.randrange(1, R381) for _ in range(l)]
+    pts = [G1_HOST.scalar_mul(gen, k) for k in ks]
+    packed = pp.packexp_from_public(C, C.encode(pts), method="dense")
+    from distributed_groth16_tpu.parallel.pss import pack_host
+
+    exp_shares = pack_host(pp, ks)
+    expect = [G1_HOST.scalar_mul(gen, e) for e in exp_shares]
+    assert C.decode(packed) == expect
+    back = pp.unpackexp(C, packed, method="dense")
+    assert C.decode(back) == pts
+
+
+def test_pss381_device_field_transforms_raise():
+    with pytest.raises(NotImplementedError):
+        import jax.numpy as jnp
+
+        pp = pss381(2)
+        pp.pack_from_public(jnp.zeros((1, 2, 16), jnp.uint32))
+
+
+def test_d_msm_bls12_381_matches_host():
+    """Distributed d_msm over BLS12-381 G1 with packed sharing over r381
+    (BASELINE config 5's protocol shape) vs the host MSM."""
+    import jax.numpy as jnp
+
+    from distributed_groth16_tpu.ops.bls12_381 import (
+        fr381,
+        pack_scalars_381,
+    )
+    from distributed_groth16_tpu.parallel.dmsm import d_msm
+    from distributed_groth16_tpu.parallel.net import simulate_network_round
+
+    l, n_parties, m = 2, 8, 8
+    pp = pss381(l)
+    C = g1_381()
+    gen = g1_generator_381()
+    rng = np.random.default_rng(9)
+    ks = [int(x) for x in rng.integers(1, 2**50, size=m)]
+    pts = [G1_HOST.scalar_mul(gen, k) for k in ks]
+    scalars = [
+        int.from_bytes(rng.bytes(40), "little") % R381 for _ in range(m)
+    ]
+    expected = G1_HOST.msm(pts, scalars)
+
+    s_shares = pack_scalars_381(pp, scalars)
+    base_chunks = C.encode(pts).reshape((m // l, l, 3) + C.elem_shape)
+    b_shares = jnp.swapaxes(
+        pp.packexp_from_public(C, base_chunks, method="dense"), 0, 1
+    )
+
+    async def party(net, data):
+        return await d_msm(C, data[0], data[1], pp, net,
+                           scalar_field=fr381())
+
+    outs = simulate_network_round(
+        n_parties, party,
+        [(b_shares[i], s_shares[i]) for i in range(n_parties)],
+    )
+    for o in outs:
+        assert C.decode(o) == expected
