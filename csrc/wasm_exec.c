@@ -73,7 +73,11 @@ typedef struct {
     int64_t nfuncs;
     HostFn host;
 
-    uint64_t *vstack; /* shared value stack */
+    uint64_t *vstack; /* shared value stack (points GUARD slots into
+                       * vstack_alloc: hostile-module stack underflow
+                       * stays inside our allocation — see wx_new) */
+    uint64_t *vstack_alloc;
+    int64_t guard;
     jmp_buf trap_jmp;
     int32_t trap_code;
     int64_t call_depth;
@@ -440,9 +444,24 @@ Engine *wx_new(const int64_t *ins_flat, int64_t n_ins,
     E->n_imports = n_imports;
     E->nfuncs = nfuncs;
     E->host = host;
-    E->vstack = (uint64_t *)malloc(VALUE_STACK_CAP * sizeof(uint64_t));
+    /* underflow guard: an unbalanced (hostile) function body can pop at
+     * most 3 values per instruction below its base, and every base is
+     * >= 0, so a guard band of 3*max_body_len slots below the logical
+     * stack keeps ALL underflowing accesses inside this allocation
+     * (garbage values, but memory-safe) */
+    int64_t max_body = 0;
+    for (int64_t f = 0; f < nfuncs; f++) {
+        int64_t len = func_off[f + 1] - func_off[f];
+        if (len > max_body) max_body = len;
+    }
+    E->guard = 3 * max_body + 64;
+    E->vstack_alloc = (uint64_t *)calloc(
+        (size_t)(E->guard + VALUE_STACK_CAP), sizeof(uint64_t));
     E->frames = malloc(FRAME_POOL_CAP * sizeof(Frame));
-    if (!E->vstack || !E->frames) { free(E->vstack); free(E->frames); free(E); return NULL; }
+    if (!E->vstack_alloc || !E->frames) {
+        free(E->vstack_alloc); free(E->frames); free(E); return NULL;
+    }
+    E->vstack = E->vstack_alloc + E->guard;
     return E;
 }
 
@@ -458,7 +477,7 @@ void wx_free(Engine *E) {
     free((void *)E->imp_nparams);
     free((void *)E->imp_nresults);
     free((void *)E->br_pool);
-    free(E->vstack);
+    free(E->vstack_alloc);
     free(E->frames);
     free(E);
 }

@@ -135,6 +135,10 @@ def _serialize(m: Module):
                 raise WasmTrap(f"call target {a} out of range")
             if op == 0x11 and not 0 <= a < len(m.types):
                 raise WasmTrap(f"call_indirect type {a} out of range")
+            if op in (0x02, 0x04, 0x05) and b < 0:
+                # a truncated body leaves end/else pcs unpatched (-1); the
+                # C engine would jump to pc=-1 and execute garbage quads
+                raise WasmTrap("unterminated control structure")
             if op == 0x0E:  # br_table: a=targets list, b=default
                 ins_rows.append((op, len(br_pool), len(a), b))
                 br_pool.extend(a)

@@ -148,16 +148,9 @@ def g1_377():
 
 def encode_scalars_377(values):
     """Python ints -> (n, 16) standard-form u32 limbs mod r377."""
-    import numpy as np
+    from .scalar_pack import encode_scalars
 
-    import jax.numpy as jnp
-
-    from .constants import to_limbs
-
-    out = np.array(
-        [to_limbs(int(v) % R377) for v in values], dtype=np.uint32
-    )
-    return jnp.asarray(out)
+    return encode_scalars(values, R377)
 
 
 # --------------------------------------------------------------------------
@@ -182,31 +175,8 @@ def pss377(l: int):
 
 
 def pack_scalars_377(pp, values):
-    """Pack Fr377 secrets l-at-a-time into n shares, device-side: one
-    (n, l) matrix mul-add over PrimeField(R377) Montgomery tensors.
+    """Pack Fr377 secrets into n Montgomery shares (scalar_pack.pack_scalars
+    over PrimeField(R377); CONSECUTIVE chunking)."""
+    from .scalar_pack import pack_scalars
 
-    values: flat list of ints (length a multiple of l, zero-padded
-    otherwise). Returns (n, c, 16) Montgomery share tensors, c = len/l,
-    CONSECUTIVE chunking: chunk j packs values[j*l : (j+1)*l] (the
-    pack_consecutive convention — pair with identically-chunked
-    packexp_from_public base shares)."""
-    import jax.numpy as jnp
-
-    F = fr377()
-    vals = [int(v) % R377 for v in values]
-    rem = (-len(vals)) % pp.l
-    vals += [0] * rem
-    c = len(vals) // pp.l
-    # chunk j = (vals[j*l], ..., vals[j*l + l-1]) -> secrets of share row
-    chunks = F.encode(vals)  # (c*l, 16)
-    chunks = chunks.reshape(c, pp.l, 16)
-    mat = F.encode([pp.pack_matrix[p][i] for p in range(pp.n)
-                    for i in range(pp.l)]).reshape(pp.n, pp.l, 16)
-    # out[p, j] = sum_i mat[p, i] * chunks[j, i]
-    out = []
-    for p in range(pp.n):
-        acc = F.mul(chunks[:, 0, :], mat[p, 0][None, :])
-        for i in range(1, pp.l):
-            acc = F.add(acc, F.mul(chunks[:, i, :], mat[p, i][None, :]))
-        out.append(acc)
-    return jnp.stack(out, axis=0)  # (n, c, 16)
+    return pack_scalars(pp, values, fr377(), R377)

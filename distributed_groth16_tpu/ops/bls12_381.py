@@ -234,15 +234,9 @@ def g2_381():
 
 def encode_scalars_381(values):
     """Python ints -> (n, 16) standard-form u32 limbs mod r381."""
-    import jax.numpy as jnp
-    import numpy as np
+    from .scalar_pack import encode_scalars
 
-    from .constants import to_limbs
-
-    out = np.array(
-        [to_limbs(int(v) % R381) for v in values], dtype=np.uint32
-    )
-    return jnp.asarray(out)
+    return encode_scalars(values, R381)
 
 
 @functools.cache
@@ -256,24 +250,8 @@ def pss381(l: int):
 
 
 def pack_scalars_381(pp, values):
-    """Pack Fr381 secrets l-at-a-time into n shares, device-side (the
-    pack_scalars_377 pattern; nl=17 here — the 255-bit r381 takes
-    Montgomery radix 2^272). CONSECUTIVE chunking."""
-    import jax.numpy as jnp
+    """Pack Fr381 secrets into n Montgomery shares (scalar_pack.pack_scalars
+    over PrimeField(R381), nl=17; CONSECUTIVE chunking)."""
+    from .scalar_pack import pack_scalars
 
-    F = fr381()
-    nl = F.nl
-    vals = [int(v) % R381 for v in values]
-    vals += [0] * ((-len(vals)) % pp.l)
-    c = len(vals) // pp.l
-    chunks = F.encode(vals).reshape(c, pp.l, nl)
-    mat = F.encode(
-        [pp.pack_matrix[p][i] for p in range(pp.n) for i in range(pp.l)]
-    ).reshape(pp.n, pp.l, nl)
-    out = []
-    for p in range(pp.n):
-        acc = F.mul(chunks[:, 0, :], mat[p, 0][None, :])
-        for i in range(1, pp.l):
-            acc = F.add(acc, F.mul(chunks[:, i, :], mat[p, i][None, :]))
-        out.append(acc)
-    return jnp.stack(out, axis=0)  # (n, c, nl)
+    return pack_scalars(pp, values, fr381(), R381)

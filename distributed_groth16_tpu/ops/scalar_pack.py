@@ -1,0 +1,46 @@
+"""Curve-generic scalar encoding + packed-share construction, shared by
+the BLS curve configurations (ops/bls12_377.py, ops/bls12_381.py).
+
+The BN254 path has its own device-NTT packing (parallel/pss.py); for
+other scalar fields the pack map is applied as an explicit (n, l) matrix
+mul-add over the field's PrimeField tensors."""
+
+from __future__ import annotations
+
+
+def encode_scalars(values, r: int):
+    """Python ints -> (n, 16) standard-form u32 limbs mod r (r < 2^256)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .constants import to_limbs
+
+    out = np.array([to_limbs(int(v) % r) for v in values], dtype=np.uint32)
+    return jnp.asarray(out)
+
+
+def pack_scalars(pp, values, F, r: int):
+    """Pack secrets l-at-a-time into n Montgomery share tensors,
+    device-side: out[p, j] = sum_i M[p][i] * chunk_j[i] over PrimeField F
+    (F.nl carries the limb count — 16 for r377, 17 for r381).
+
+    CONSECUTIVE chunking: chunk j packs values[j*l : (j+1)*l] (the
+    pack_consecutive convention — pair with identically-chunked
+    packexp_from_public base shares). Returns (n, c, F.nl)."""
+    import jax.numpy as jnp
+
+    nl = F.nl
+    vals = [int(v) % r for v in values]
+    vals += [0] * ((-len(vals)) % pp.l)
+    c = len(vals) // pp.l
+    chunks = F.encode(vals).reshape(c, pp.l, nl)
+    mat = F.encode(
+        [pp.pack_matrix[p][i] for p in range(pp.n) for i in range(pp.l)]
+    ).reshape(pp.n, pp.l, nl)
+    out = []
+    for p in range(pp.n):
+        acc = F.mul(chunks[:, 0, :], mat[p, 0][None, :])
+        for i in range(1, pp.l):
+            acc = F.add(acc, F.mul(chunks[:, i, :], mat[p, i][None, :]))
+        out.append(acc)
+    return jnp.stack(out, axis=0)

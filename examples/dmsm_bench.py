@@ -85,6 +85,7 @@ def main() -> int:
             g1_generator_381,
             g2_381,
             g2_generator_381,
+            pack_scalars_381,
             pss381,
         )
 
@@ -98,10 +99,6 @@ def main() -> int:
         pp = pss381(args.l)
 
         def pack_scalar_shares(scalars_int):
-            from distributed_groth16_tpu.ops.bls12_381 import (
-                pack_scalars_381,
-            )
-
             return pack_scalars_381(pp, scalars_int)
     else:
         C, gen, r_mod = g1(), G1_GENERATOR, R

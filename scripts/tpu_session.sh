@@ -1,0 +1,13 @@
+#!/bin/bash
+# One-shot TPU measurement session: run the moment the axon tunnel answers.
+# 1. bench.py (tree-MSM 2^16 + 2^20 lanes + NTT 2^20) -> JSON line
+# 2. single-node sha256 prove wall-clock on the chip (BASELINE config 1)
+# Usage: bash scripts/tpu_session.sh [logfile]
+set -u
+LOG=${1:-/tmp/tpu_session.log}
+cd "$(dirname "$0")/.."
+echo "=== bench.py ($(date -u +%FT%TZ)) ===" | tee -a "$LOG"
+timeout 3600 python bench.py 2>&1 | tee -a "$LOG"
+echo "=== sha256 e2e single-node on chip ===" | tee -a "$LOG"
+timeout 7200 python examples/sha256.py --skip-mpc 2>&1 | tail -20 | tee -a "$LOG"
+echo "=== done ($(date -u +%FT%TZ)) ===" | tee -a "$LOG"
