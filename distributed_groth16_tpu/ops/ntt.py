@@ -146,14 +146,20 @@ def _limb_ntt_ok(n: int) -> bool:
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _limb_ntt_route(x, n: int, inverse: bool):
     """(..., n, 16) row-major <-> limb-major shim around ntt_limb (no 1/n
-    scaling — the caller's ifft applies size_inv, as with _ntt_core)."""
-    from .ntt_limb import ntt_limb
+    scaling — the caller's ifft applies size_inv, as with _ntt_core).
+
+    The limb pipeline works in the redundant [0, 2p) Montgomery class;
+    the row-major world requires CANONICAL limbs (returning redundant
+    representatives silently corrupted downstream F.mul results — caught
+    by the prove_single integration test), so canon() at the boundary."""
+    from .ntt_limb import lfr, ntt_limb
 
     batch = x.shape[:-2]
     flat = x.reshape((-1, n, N_LIMBS))
 
     def one(v):  # (n, 16) -> (n, 16)
-        return jnp.transpose(ntt_limb(jnp.transpose(v), n, inverse))
+        return jnp.transpose(lfr().canon(ntt_limb(jnp.transpose(v), n,
+                                                  inverse)))
 
     out = jax.vmap(one)(flat)
     return out.reshape(batch + (n, N_LIMBS))
