@@ -10,6 +10,8 @@ the sha256 fixture, whose compiled .r1cs is not checked in — mycircuit is
 the largest circuit with both artifacts present).
 
 Run: python examples/circom_e2e.py [--a 3] [--b 11]
+(CPU by default: set DG16_EXAMPLE_TPU=1 to keep the ambient accelerator
+backend — without a reachable chip, backend discovery blocks forever.)
 """
 
 from __future__ import annotations
@@ -24,6 +26,15 @@ sys.path.insert(0, _ROOT)
 
 VECTORS = "/root/reference/ark-circom/test-vectors"
 
+if os.environ.get("DG16_EXAMPLE_TPU") != "1":
+    # same dance as tests/conftest.py: the experimental TPU plugin hooks
+    # backend discovery at init and hangs when its tunnel is down; strip
+    # it and pin CPU before anything touches a backend
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -31,8 +42,6 @@ def main() -> int:
     ap.add_argument("--b", type=int, default=11)
     ap.add_argument("--l", type=int, default=2)
     args = ap.parse_args()
-
-    import jax.numpy as jnp
 
     from distributed_groth16_tpu.frontend.builder import (
         CircomBuilder,

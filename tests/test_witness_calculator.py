@@ -75,11 +75,14 @@ def test_circom1_witness_satisfies_real_r1cs():
     reason="no fixture",
 )
 def test_sha256_witness_at_scale():
-    """Full sha256 circuit witness (~30k wires, several minutes of
-    interpreted WASM) — proves the interpreter at scale (no compiled
-    .r1cs ships for this fixture, so checks shape/determinism). Slow."""
-    wc = WitnessCalculator.from_file(
-        "/root/reference/fixtures/sha256/sha256_js/sha256.wasm"
-    )
+    """Full sha256 circuit witness (~30k wires) on the PURE-PYTHON VM —
+    several minutes of interpreted WASM; proves that interpreter at scale
+    (the default engine is the C tier, covered at this scale by
+    test_wasm_cexec.py's slow lane). No compiled .r1cs ships for this
+    fixture, so checks shape/determinism. Slow."""
+    with open(
+        "/root/reference/fixtures/sha256/sha256_js/sha256.wasm", "rb"
+    ) as f:
+        wc = WitnessCalculator(f.read(), engine="python")
     w = wc.calculate_witness({"a": 1, "b": 2})
     assert w[0] == 1 and len(w) == 29823
