@@ -3,9 +3,12 @@
 Metric (BASELINE.json): "Groth16 prover wall-clock + MSM scalar-muls/sec
 (SHA-256 circuit, BN254)". The headline number is the MSM kernel throughput
 on the real chip — the dominant per-party compute of the prover (five MSMs
-per proof, dist-primitives/src/dmsm/mod.rs:82): BN254 G1 MSM over 2^16
-points via the limb-major Pallas tree path (ops/limb_kernels.py),
-steady-state scalar-muls/sec.
+per proof, dist-primitives/src/dmsm/mod.rs:82): BN254 G1 MSM via the
+limb-major Pallas tree path (ops/limb_kernels.py), steady-state
+scalar-muls/sec, measured as a staged 2^12 -> 2^16 -> 2^20 sweep (headline
+= largest size that completed; per-size numbers are kept as msm_2e*_ keys).
+A watchdog emits the partial JSON line if a stage wedges past the deadline
+or the driver SIGTERMs the process mid-stage.
 
 Timing methodology: the remote-TPU tunnel used here has tens of
 milliseconds of per-call latency/variance and `block_until_ready` is not a
@@ -25,13 +28,43 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
+import threading
 import time
 
 import traceback
 
-LOG2N = 16  # headline size (2^16); a 2^20 point is also measured
 ARKWORKS_CPU_MSM_PER_SEC = 1.0e6  # documented ballpark, see module docstring
+
+_PRINTED = False
+_PRINT_LOCK = threading.Lock()
+
+
+def _emit(res: dict, stage_s: dict, platform: str) -> None:
+    """Print the single JSON line (idempotent; safe from watchdog/handler)."""
+    global _PRINTED
+    with _PRINT_LOCK:
+        if _PRINTED:
+            return
+        _PRINTED = True
+    from distributed_groth16_tpu.ops.limb_kernels import _pallas_roll_mode
+
+    out = {
+        "metric": res.get("metric", "msm_g1_scalar_muls_per_sec"),
+        "value": res.get("value", 0),
+        "unit": "scalar-muls/sec",
+        # numeric always (driver-parsed); the metric name carries the
+        # measured size, and the denominator stays the 2^16-2^20
+        # arkworks ballpark documented in BASELINE.md
+        "vs_baseline": round(res.get("value", 0) / ARKWORKS_CPU_MSM_PER_SEC, 4),
+        "platform": platform,
+        "method": "marginal (t3-t1)/2, jitted K-loop, host-sync",
+        "stage_seconds": dict(stage_s),
+        "pallas_roll": _pallas_roll_mode(),
+        **{k: v for k, v in res.items() if k not in ("metric", "value")},
+    }
+    print(json.dumps(out), flush=True)
 
 
 def _probe_tpu(timeout: float = 150.0) -> bool:
@@ -125,19 +158,49 @@ def main() -> None:
         per_msm = marginal_cost(make, (points, scalars))
         return n / per_msm, per_msm
 
-    # CPU fallback guard: the tree MSM at 2^16/2^20 takes hours on the
-    # XLA:CPU bodies; measure a small size instead so the driver's bench
-    # budget survives a dead tunnel (the JSON carries platform="cpu" so the
-    # number is clearly not the TPU metric).
-    log2n = LOG2N if platform == "tpu" else 12
-    muls_per_sec, per_msm = measure(log2n)
-    muls_2e20, per_msm_2e20 = None, None
-    ntt_2e20_ms = None
-    if platform == "tpu":
-        try:  # BASELINE config 2's size; reported alongside the headline
-            muls_2e20, per_msm_2e20 = measure(20)
-        except Exception:  # memory/tunnel pressure must not kill the bench
-            pass
+    # Staged, deadline-guarded: smallest size first so a pathological
+    # remote compile (the 2026-07-31 monolithic 2^16 program wedged the
+    # Mosaic service for 40+ min) can never leave the round with zero
+    # numbers. A watchdog thread prints whatever stages completed if the
+    # deadline passes MID-stage (a wedged compile is a hang, not an
+    # exception), and SIGTERM from the driver does the same.
+    deadline = time.time() + float(os.environ.get("DG16_BENCH_BUDGET_S", "2700"))
+    res: dict = {}
+    stage_s: dict = {}
+
+    def _watchdog():
+        while not _PRINTED:
+            if time.time() > deadline + 60.0:
+                _emit(res, stage_s, platform)
+                os._exit(0)
+            time.sleep(10.0)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    signal.signal(
+        signal.SIGTERM,
+        lambda *a: (_emit(res, stage_s, platform), os._exit(0)),
+    )
+
+    sizes = [12, 16, 20] if platform == "tpu" else [12]
+    for log2n in sizes:
+        if res and time.time() > deadline:
+            break
+        t0 = time.time()
+        try:
+            muls_per_sec, per_msm = measure(log2n)
+        except Exception as e:
+            res.setdefault("errors", []).append(
+                f"msm_2e{log2n}: {type(e).__name__}: {e}"
+            )
+            break
+        stage_s[f"msm_2e{log2n}"] = round(time.time() - t0, 1)
+        res["metric"] = f"msm_g1_scalar_muls_per_sec_2e{log2n}"
+        res["value"] = round(muls_per_sec, 1)
+        res["per_msm_ms"] = round(per_msm * 1e3, 1)
+        res["measured_log2n"] = log2n
+        res[f"msm_2e{log2n}_per_sec"] = round(muls_per_sec, 1)
+        res[f"msm_2e{log2n}_ms"] = round(per_msm * 1e3, 1)
+    if platform == "tpu" and time.time() < deadline:
         try:  # BASELINE config 3's kernel: radix-2 NTT over Fr (Pallas
             # four-step limb path), 2^20 coefficients
             from distributed_groth16_tpu.ops.ntt_limb import ntt_limb
@@ -158,31 +221,14 @@ def main() -> None:
 
                 return run
 
-            ntt_2e20_ms = round(marginal_cost(make_ntt, (x,)) * 1e3, 1)
-        except Exception:
-            pass
-    print(
-        json.dumps(
-            {
-                "metric": f"msm_g1_scalar_muls_per_sec_2e{log2n}",
-                "value": round(muls_per_sec, 1),
-                "unit": "scalar-muls/sec",
-                # numeric always (driver-parsed); the metric name carries
-                # the measured size, and the denominator stays the 2^16-2^20
-                # arkworks ballpark documented in BASELINE.md
-                "vs_baseline": round(
-                    muls_per_sec / ARKWORKS_CPU_MSM_PER_SEC, 4
-                ),
-                "platform": platform,
-                "per_msm_ms": round(per_msm * 1e3, 1),
-                "measured_log2n": log2n,
-                "msm_2e20_per_sec": None if muls_2e20 is None else round(muls_2e20, 1),
-                "msm_2e20_ms": None if per_msm_2e20 is None else round(per_msm_2e20 * 1e3, 1),
-                "ntt_2e20_ms": ntt_2e20_ms,
-                "method": "marginal (t3-t1)/2, jitted K-loop, host-sync",
-            }
-        )
-    )
+            t0 = time.time()
+            res["ntt_2e20_ms"] = round(marginal_cost(make_ntt, (x,)) * 1e3, 1)
+            stage_s["ntt_2e20"] = round(time.time() - t0, 1)
+        except Exception as e:
+            res.setdefault("errors", []).append(
+                f"ntt: {type(e).__name__}: {e}"
+            )
+    _emit(res, stage_s, platform)
 
 
 if __name__ == "__main__":
@@ -193,7 +239,7 @@ if __name__ == "__main__":
         print(
             json.dumps(
                 {
-                    "metric": "msm_g1_scalar_muls_per_sec_2e16",
+                    "metric": "msm_g1_scalar_muls_per_sec",
                     "value": 0,
                     "unit": "scalar-muls/sec",
                     "vs_baseline": 0,

@@ -24,6 +24,7 @@ for BN254 Fq; the same machinery can host BLS12-381's base field.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +38,28 @@ NL = N_LIMBS
 # Pallas lane-axis tile; 2048 measured fastest for the fused add kernel on
 # v5e (1024 and 4096 are both ~25% slower; 8192 exceeds scoped VMEM).
 TILE = 2048
+
+
+def _pallas_roll_mode() -> str:
+    """How Pallas kernel bodies are built — a compile-time/runtime tradeoff.
+
+    'unroll': trace-time flat bodies (~6k vector ops per group-law kernel).
+        Fastest steady state, but with ~30 kernel instances per MSM program
+        the remote Mosaic compile of the monolithic tree at 2^16 ran 40+
+        minutes without completing (2026-07-31, v5e tunnel).
+    'fori':   CIOS rounds + carry chains as lax.fori_loop with masked
+        sublane row-extraction (~10x smaller bodies, ~+25%% vector ops).
+    'scan':   the unroll=False lax.scan formulation (same bodies the XLA
+        fallback runs) — smallest graphs, but relies on Mosaic lowering
+        scan xs-slicing on the sublane axis.
+    """
+    return os.environ.get("DG16_PALLAS_ROLL", "fori")
+
+
+def kernel_roll_mode():
+    """unroll arg for Pallas kernel bodies, from DG16_PALLAS_ROLL."""
+    m = _pallas_roll_mode()
+    return True if m == "unroll" else (False if m == "scan" else "fori")
 
 
 def _pl():
@@ -60,6 +83,29 @@ def use_pallas() -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _extract_mode() -> str:
+    """Sublane row extraction inside fori bodies: 'mask' (iota+select+
+    reduce — always lowers) or 'dyn' (dynamic_slice on the sublane axis)."""
+    return os.environ.get("DG16_PALLAS_EXTRACT", "mask")
+
+
+def _row(a, i):
+    """Row i of (k, n) as (1, n); i may be a traced loop index."""
+    if _extract_mode() == "dyn":
+        return jax.lax.dynamic_slice_in_dim(a, i, 1, axis=0)
+    iota = jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
+    picked = jnp.where(iota == i, a, jnp.uint32(0)).astype(jnp.int32)
+    return jnp.sum(picked, axis=0, keepdims=True).astype(jnp.uint32)
+
+
+def _setrow(out, i, row):
+    """out with row i replaced by row (1, n); i may be traced."""
+    if _extract_mode() == "dyn":
+        return jax.lax.dynamic_update_slice_in_dim(out, row, i, axis=0)
+    iota = jax.lax.broadcasted_iota(jnp.int32, out.shape, 0)
+    return jnp.where(iota == i, row, out)
+
+
 class LimbField:
     """Montgomery arithmetic on limb-major uint32[16, n] in [0, 2p)."""
 
@@ -73,10 +119,14 @@ class LimbField:
     # consts are passed in explicitly so the same bodies work inside Pallas
     # kernels (which reject captured device constants).
 
-    # Each helper has two formulations with IDENTICAL op sequences (hence
-    # identical numerics): trace-time unrolled for Pallas kernels (Mosaic
-    # wants flat graphs) and `lax.scan`-rolled for the plain-XLA fallback
-    # (unrolled 3k-op graphs made CPU test compiles minutes-long).
+    # Each helper has THREE formulations with IDENTICAL op sequences (hence
+    # identical numerics), selected by `unroll`: True = trace-time unrolled
+    # (flat bodies — fastest steady state, but the compile cost of ~30 such
+    # kernel instances wedged the remote Mosaic service for 40+ min on the
+    # 2^16 tree program); False = `lax.scan`-rolled for the plain-XLA
+    # fallback (unrolled 3k-op graphs made CPU test compiles minutes-long);
+    # "fori" = `lax.fori_loop`-rolled with masked sublane extraction, the
+    # Pallas compile-friendly middle ground (~10x smaller bodies).
 
     def carry(self, v, unroll=True):
         """(k, n) lazy rows -> (16, n) carried limbs (value < 2^256).
@@ -85,6 +135,16 @@ class LimbField:
         invariant) are dropped.
         """
         v = v[:NL]
+        if unroll == "fori":
+            def body(i, st):
+                out, c = st
+                t = _row(v, i) + c
+                return _setrow(out, i, t & MASK), t >> LIMB_BITS
+
+            out, _ = jax.lax.fori_loop(
+                0, NL, body, (jnp.zeros_like(v), jnp.zeros_like(v[0:1]))
+            )
+            return out
         if not unroll:
             def step(c, row):
                 t = row + c
@@ -102,6 +162,18 @@ class LimbField:
     @staticmethod
     def _cond_sub(a, m_col, unroll=True):
         """a - m if a >= m else a; a carried, m a (16,1) numpy/jnp column."""
+        if unroll == "fori":
+            m_col = jnp.asarray(m_col)
+
+            def body(i, st):
+                d, b = st
+                t = _row(a, i) - _row(m_col, i) - b
+                return _setrow(d, i, t & MASK), t >> 31
+
+            d, b = jax.lax.fori_loop(
+                0, NL, body, (jnp.zeros_like(a), jnp.zeros_like(a[0:1]))
+            )
+            return jnp.where(b == 0, d, a)
         if not unroll:
             def step(b, xs):
                 ai, mi = xs
@@ -126,6 +198,18 @@ class LimbField:
 
     def neg(self, b, p2, unroll=True):
         """2p - b (the additive inverse in the redundant class), b < 2p."""
+        if unroll == "fori":
+            p2 = jnp.asarray(p2)
+
+            def body(i, st):
+                out, brw = st
+                t = _row(p2, i) - _row(b, i) - brw
+                return _setrow(out, i, t & MASK), t >> 31
+
+            out, _ = jax.lax.fori_loop(
+                0, NL, body, (jnp.zeros_like(b), jnp.zeros_like(b[0:1]))
+            )
+            return out
         if not unroll:
             def step(brw, xs):
                 bi, pi = xs
@@ -175,6 +259,11 @@ class LimbField:
             )
 
         v0 = jnp.zeros((NL + 1, n), jnp.uint32)
+        if unroll == "fori":
+            v = jax.lax.fori_loop(
+                0, NL, lambda i, v: step(v, _row(a, i)), v0
+            )
+            return self.carry(v, unroll="fori")
         if not unroll:
             v, _ = jax.lax.scan(
                 lambda v, ai: (step(v, ai[None]), None), v0, a[:NL]
@@ -396,6 +485,8 @@ class LimbGroup:
 
     # -- pallas / XLA dispatch ---------------------------------------------
 
+    _kmode = staticmethod(kernel_roll_mode)
+
     def _consts(self):
         return jnp.asarray(self.consts_np)
 
@@ -417,7 +508,9 @@ class LimbGroup:
         RR, T, CROWS = self.ROWS, self.tile, self.consts_np.shape[0]
 
         def kern(p_ref, q_ref, c_ref, o_ref):
-            o_ref[:] = self.add_body(p_ref[:], q_ref[:], c_ref[:])
+            o_ref[:] = self.add_body(
+                p_ref[:], q_ref[:], c_ref[:], unroll=self._kmode()
+            )
 
         @jax.jit
         def run(p, q):
@@ -446,7 +539,9 @@ class LimbGroup:
         RR, T, CROWS = self.ROWS, self.tile, self.consts_np.shape[0]
 
         def kern(p_ref, c_ref, o_ref):
-            o_ref[:] = self.double_body(p_ref[:], c_ref[:])
+            o_ref[:] = self.double_body(
+                p_ref[:], c_ref[:], unroll=self._kmode()
+            )
 
         @jax.jit
         def run(p):
@@ -540,7 +635,9 @@ class LimbGroup:
                     jnp.uint32
                 )
 
-            o_ref[:] = self.horner_body(getcol, c_ref[:], c, W)
+            o_ref[:] = self.horner_body(
+                getcol, c_ref[:], c, W, unroll=self._kmode()
+            )
 
         @jax.jit
         def run(s):

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .constants import FR_GENERATOR, R, to_limbs
-from .limb_kernels import NL, LimbField, _pl, use_pallas
+from .limb_kernels import NL, LimbField, _pl, kernel_roll_mode, use_pallas
 from .ntt import bitrev_perm
 from .refmath import finv
 
@@ -137,7 +137,7 @@ class _SmallNTT:
             consts = c_ref[:]
             o_ref[:] = _ntt_body(
                 x_ref[:], tw_ref[:], consts[0:NL], consts[NL:],
-                logn, unroll=True,
+                logn, unroll=kernel_roll_mode(),
             )
 
         consts = np.concatenate([F.p_col, F.p2_col], axis=0)

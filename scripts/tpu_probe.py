@@ -1,0 +1,157 @@
+"""Staged TPU probe: incremental JSON lines, smallest compiles first.
+
+Diagnoses where the remote-TPU time goes before committing to the full
+bench.py program: (0) trivial dispatch, (1) the Pallas G1 add kernel at a
+few batch widths, (2) the fused NTT kernel, (3) a small tree MSM, then
+(4) the headline sizes. Each stage prints its own line immediately, so a
+wedged tunnel or a pathological compile is visible mid-run rather than as
+45 minutes of silence.
+
+Usage: python scripts/tpu_probe.py [--stages 0,1,2,3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", default="0,1,2,3")
+    ap.add_argument("--msm-log2n", type=int, default=12)
+    args = ap.parse_args()
+    stages = {int(s) for s in args.stages.split(",")}
+
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_groth16_tpu.utils.cache import setup_compile_cache
+
+    setup_compile_cache(jax, os.path.join(os.path.dirname(__file__), ".."))
+
+    plat = jax.devices()[0].platform
+    emit(stage="init", platform=plat, t=round(time.time() - t0, 1))
+
+    from distributed_groth16_tpu.utils.benchtools import marginal_cost
+
+    if 0 in stages:
+        t = time.time()
+        x = jnp.arange(8192, dtype=jnp.uint32)
+        y = int((x * x + jnp.uint32(3)).sum())
+        emit(stage="trivial", ok=y > 0, t=round(time.time() - t, 1))
+
+    if 1 in stages:
+        from distributed_groth16_tpu.ops.limb_kernels import lg1
+
+        g = lg1()
+        for log2n in (14, 17, 20):
+            n = 1 << log2n
+            t = time.time()
+            # random-ish valid points: broadcast generator, vary via double
+            from distributed_groth16_tpu.ops.constants import G1_GENERATOR
+            from distributed_groth16_tpu.ops.curve import g1
+
+            base = g1().encode([G1_GENERATOR])[0]
+            pts = jnp.broadcast_to(base.reshape(48, 1), (48, n))
+
+            def make(k: int):
+                @jax.jit
+                def run(p):
+                    acc = p
+                    for _ in range(k):
+                        acc = g._pallas_add(acc, p) if plat == "tpu" else g._xla_add(acc, p)
+                    return acc[0].sum(dtype=jnp.uint32)
+
+                return run
+
+            per = marginal_cost(make, (pts,))
+            emit(
+                stage="pallas_add",
+                log2n=log2n,
+                adds_per_sec=round(n / per),
+                per_call_ms=round(per * 1e3, 2),
+                compile_s=round(time.time() - t, 1),
+            )
+
+    if 2 in stages:
+        from distributed_groth16_tpu.ops.ntt_limb import ntt_limb
+
+        rng = np.random.default_rng(1)
+        for log2n in (12, 16, 20):
+            n = 1 << log2n
+            t = time.time()
+            x = jnp.asarray(
+                rng.integers(0, 1 << 16, size=(16, n), dtype=np.uint32)
+            )
+
+            def make(k: int):
+                @jax.jit
+                def run(x):
+                    acc = jnp.uint32(0)
+                    for i in range(k):
+                        out = ntt_limb(x ^ jnp.uint32(i), n, False)
+                        acc = acc + out.sum(dtype=jnp.uint32)
+                    return acc
+
+                return run
+
+            per = marginal_cost(make, (x,))
+            emit(
+                stage="ntt",
+                log2n=log2n,
+                per_call_ms=round(per * 1e3, 2),
+                compile_s=round(time.time() - t, 1),
+            )
+
+    if 3 in stages:
+        from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
+        from distributed_groth16_tpu.ops.curve import g1
+        from distributed_groth16_tpu.ops.limb_kernels import _msm_tree_jit, lg1
+        from distributed_groth16_tpu.ops.msm import encode_scalars_std
+
+        inner = _msm_tree_jit.__wrapped__
+        rng = np.random.default_rng(2)
+        n = 1 << args.msm_log2n
+        t = time.time()
+        scalars = encode_scalars_std(
+            [int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)]
+        )
+        points = jnp.broadcast_to(g1().encode([G1_GENERATOR])[0], (n, 3, 16))
+
+        def make(k: int):
+            @jax.jit
+            def run(points, scalars):
+                acc = jnp.uint32(0)
+                for i in range(k):
+                    sc = scalars ^ jnp.uint32(i)
+                    out = inner(lg1(), points, sc, 8, None)
+                    acc = acc + out.sum(dtype=jnp.uint32)
+                return acc
+
+            return run
+
+        per = marginal_cost(make, (points, scalars))
+        emit(
+            stage="msm_tree",
+            log2n=args.msm_log2n,
+            muls_per_sec=round(n / per),
+            per_msm_ms=round(per * 1e3, 1),
+            compile_s=round(time.time() - t, 1),
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

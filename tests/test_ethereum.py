@@ -72,3 +72,38 @@ def test_calldata_and_json_shapes():
     assert data[3] == [f"0x{v:064x}" for v in publics]
     pj = proof_to_json(proof)
     assert pj["protocol"] == "groth16" and len(pj["pi_b"]) == 3
+
+
+MILLION = "/root/reference/fixtures/million"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{MILLION}/proof.json"), reason="no million fixture"
+)
+def test_external_proof_calldata_roundtrip_verifies():
+    """The calldata leg of the external differential (the EVM-free part of
+    ark-circom/tests/solidity.rs:1-120, whose full form needs an Anvil
+    node): a snarkjs-produced proof pushed through solidity_calldata, then
+    re-parsed from the emitted STRING exactly as verifyProof tooling would
+    split it, must still pairing-verify under the snarkjs vk."""
+    from distributed_groth16_tpu.frontend import snarkjs
+
+    vk = snarkjs.load_verification_key(f"{MILLION}/verification_key.json")
+    proof = snarkjs.load_proof(f"{MILLION}/proof.json")
+    pub = snarkjs.load_public(f"{MILLION}/public.json")
+
+    s = solidity_calldata(proof, pub)
+    a_w, b_w, c_w, in_w = json.loads("[" + s + "]")
+    as_int = lambda w: int(w, 16)
+    p2 = proof_from_eth(
+        (
+            (as_int(a_w[0]), as_int(a_w[1])),
+            (
+                (as_int(b_w[0][0]), as_int(b_w[0][1])),
+                (as_int(b_w[1][0]), as_int(b_w[1][1])),
+            ),
+            (as_int(c_w[0]), as_int(c_w[1])),
+        )
+    )
+    assert (p2.a, p2.b, p2.c) == (proof.a, proof.b, proof.c)
+    assert verify(vk, p2, [as_int(w) for w in in_w]) is True

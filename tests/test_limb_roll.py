@@ -1,0 +1,75 @@
+"""The three roll formulations of the limb-major field/group bodies must be
+bit-identical: Pallas kernels default to the `fori` (lax.fori_loop) bodies
+for compile-time reasons (ops/limb_kernels._pallas_roll_mode), but the CPU
+suite otherwise only exercises the `scan` XLA fallback — without this test a
+fori/extract regression would surface only as wrong proofs on the TPU."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_groth16_tpu.ops import limb_kernels as lk  # noqa: E402
+from distributed_groth16_tpu.ops.constants import G1_GENERATOR  # noqa: E402
+from distributed_groth16_tpu.ops.curve import g1 as g1_rm  # noqa: E402
+
+
+def _operands(n=64, seed=0):
+    F = lk.lfq()
+    rng = np.random.default_rng(seed)
+    raw = lambda: jnp.asarray(
+        rng.integers(0, 1 << 16, size=(16, n), dtype=np.uint32)
+    )
+    # halve to keep the 256-bit value < 2p after one cond_sub
+    a = F._cond_sub(F.carry(raw() >> 1), F.p2_col)
+    b = F._cond_sub(F.carry(raw() >> 1), F.p2_col)
+    return F, a, b
+
+
+@pytest.mark.parametrize("extract", ["mask", "dyn"])
+def test_field_fori_matches_unrolled(extract, monkeypatch):
+    monkeypatch.setenv("DG16_PALLAS_EXTRACT", extract)
+    F, a, b = _operands()
+    p, p2 = jnp.asarray(F.p_col), jnp.asarray(F.p2_col)
+    cases = {
+        "carry": lambda m: F.carry(a + b, unroll=m),
+        "mul": lambda m: F.mul(a, b, p, unroll=m),
+        "add": lambda m: F.add(a, b, p2, unroll=m),
+        "sub": lambda m: F.sub(a, b, p2, unroll=m),
+        "neg": lambda m: F.neg(a, p2, unroll=m),
+        "cond_sub": lambda m: F._cond_sub(a, jnp.asarray(F.p_col), m),
+    }
+    for name, fn in cases.items():
+        u = np.asarray(jax.jit(lambda: fn(True))())
+        for mode in (False, "fori"):
+            r = np.asarray(jax.jit(lambda: fn(mode))())
+            assert (u == r).all(), (name, mode, extract)
+
+
+@pytest.mark.parametrize("group", ["g1", "g2"])
+def test_group_bodies_fori_match(group):
+    g = lk.lg1() if group == "g1" else lk.lg2()
+    n = 32
+    c = jnp.asarray(g.consts_np)
+    if group == "g1":
+        base = g1_rm().encode([G1_GENERATOR])[0].reshape(g.ROWS, 1)
+    else:
+        from distributed_groth16_tpu.ops.constants import G2_GENERATOR
+        from distributed_groth16_tpu.ops.curve import g2 as g2_rm
+
+        base = g2_rm().encode([G2_GENERATOR])[0].reshape(g.ROWS, 1)
+    P = jnp.broadcast_to(base, (g.ROWS, n))
+    for body, args in (
+        (g.add_body, (P, P, c)),
+        (g.double_body, (P, c)),
+    ):
+        u = np.asarray(jax.jit(lambda: body(*args, unroll=True))())
+        for mode in (False, "fori"):
+            r = np.asarray(jax.jit(lambda: body(*args, unroll=mode))())
+            assert (u == r).all(), (body.__name__, mode)
