@@ -48,10 +48,17 @@ def _pallas_roll_mode() -> str:
         the remote Mosaic compile of the monolithic tree at 2^16 ran 40+
         minutes without completing (2026-07-31, v5e tunnel).
     'fori':   CIOS rounds + carry chains as lax.fori_loop with masked
-        sublane row-extraction (~10x smaller bodies, ~+25%% vector ops).
-    'scan':   the unroll=False lax.scan formulation (same bodies the XLA
-        fallback runs) — smallest graphs, but relies on Mosaic lowering
-        scan xs-slicing on the sublane axis.
+        sublane row-extraction — ~4x smaller StableHLO than 'unroll'
+        (2^14 tree program: 1.2 MB vs 4.7 MB) at a modest vector-op tax.
+    'scan':   the unroll=False lax.scan formulation. DOES NOT LOWER in
+        this jax's Mosaic (_scan_lowering_rule raises NotImplementedError
+        for extensive outputs) — kept only as documentation of the
+        measurement; selecting it fails at first kernel trace.
+
+    Similarly DG16_PALLAS_EXTRACT=dyn (dynamic_slice row extraction) is
+    unimplemented in Mosaic TPU lowering; 'mask' is the working mode.
+    All three formulations are bit-identical on the XLA fallback
+    (tests/test_limb_roll.py).
     """
     return os.environ.get("DG16_PALLAS_ROLL", "fori")
 
