@@ -27,15 +27,29 @@ from .field import fr
 from .refmath import finv
 
 
-def bitrev_perm(n: int) -> np.ndarray:
-    """Bit-reversal permutation indices (matches dfft/mod.rs:258-271)."""
+def _tracing_active() -> bool:
+    """True when called under a jit/vmap trace (public jax.core lost
+    trace_state_clean in this version; the _src alias remains)."""
+    from jax._src.core import trace_state_clean
+
+    return not trace_state_clean()
+
+
+def _bitrev(n: int, xp):
+    """Bit-reversal permutation over array namespace xp (np for the host
+    table, jnp for in-trace builds — one implementation for both paths)."""
     assert n > 0 and n & (n - 1) == 0, f"bitrev needs a power of two, got {n}"
     logn = n.bit_length() - 1
-    idx = np.arange(n)
-    out = np.zeros(n, dtype=np.int32)
+    idx = xp.arange(n, dtype=xp.int32)
+    out = xp.zeros((n,), dtype=xp.int32)
     for b in range(logn):
-        out |= ((idx >> b) & 1) << (logn - 1 - b)
+        out = out | (((idx >> b) & 1) << (logn - 1 - b))
     return out
+
+
+def bitrev_perm(n: int) -> np.ndarray:
+    """Bit-reversal permutation indices (matches dfft/mod.rs:258-271)."""
+    return _bitrev(n, np)
 
 
 @functools.partial(jax.jit, static_argnames=("logn", "inverse"))
@@ -101,15 +115,43 @@ class JaxDomain:
             acc = acc * self.group_gen % R
         return out
 
+    # -- trace-aware table access -------------------------------------------
+    # Under an active trace the precomputed device tables would be captured
+    # as jit CONSTANTS and baked into the lowered module as literals — at
+    # n = 2^20 that is a 64 MB literal PER TABLE (observed: 135 MB of
+    # StableHLO for one transform), the exact monolith class that wedged
+    # the remote TPU compile service. Rebuilding in-trace costs O(log n)
+    # muls of device work and keeps programs small; eager callers keep the
+    # cached concrete tables.
+
+    def _live_wpows(self):
+        if not _tracing_active():
+            return self._wpows
+        return _powers_device(self.group_gen, self.size)
+
+    def _live_perm(self):
+        if not _tracing_active():
+            return self._perm
+        return _bitrev_traced(self.size)
+
+    def _live_off(self, inverse: bool):
+        if self.offset == 1:
+            return None
+        if not _tracing_active():
+            return self._off_inv_pows if inverse else self._off_pows
+        base = finv(self.offset, R) if inverse else self.offset
+        return _powers_device(base, self.size)
+
     def fft(self, coeffs):
         """Evaluate: (..., k<=n, 16) coeffs -> (..., n, 16) evals."""
         F = fr()
         x = _zpad(coeffs, self.size)
-        if self._off_pows is not None:
-            x = F.mul(x, self._off_pows)
+        off = self._live_off(False)
+        if off is not None:
+            x = F.mul(x, off)
         if _limb_ntt_ok(self.size):
             return _limb_ntt_route(x, self.size, False)
-        return _ntt_core(x, self._perm, self._wpows, self.logn)
+        return _ntt_core(x, self._live_perm(), self._live_wpows(), self.logn)
 
     def ifft(self, evals):
         """Interpolate: (..., k<=n, 16) evals -> (..., n, 16) coeffs."""
@@ -118,10 +160,14 @@ class JaxDomain:
         if _limb_ntt_ok(self.size):
             x = _limb_ntt_route(x, self.size, True)
         else:
-            x = _ntt_core(x, self._perm, self._wpows, self.logn, inverse=True)
+            x = _ntt_core(
+                x, self._live_perm(), self._live_wpows(), self.logn,
+                inverse=True,
+            )
         x = F.mul(x, self._size_inv)
-        if self._off_inv_pows is not None:
-            x = F.mul(x, self._off_inv_pows)
+        off = self._live_off(True)
+        if off is not None:
+            x = F.mul(x, off)
         return x
 
     def get_coset(self, offset: int) -> "JaxDomain":
@@ -172,6 +218,12 @@ def _zpad(x, n):
         return x
     pad = [(0, 0)] * (x.ndim - 2) + [(0, n - k), (0, 0)]
     return jnp.pad(x, pad)
+
+
+def _bitrev_traced(n: int):
+    """(n,) int32 bit-reversal permutation as traced device ops (the numpy
+    table would bake a 4·n-byte literal into any enclosing jit)."""
+    return _bitrev(n, jnp)
 
 
 def _powers(base: int, n: int) -> list[int]:

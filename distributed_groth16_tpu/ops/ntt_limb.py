@@ -35,9 +35,12 @@ from .ntt import bitrev_perm
 from .refmath import finv
 
 # max single-kernel NTT size: the (16, S, lane-tile) block plus the stage
-# temporaries must stay inside VMEM (16*512*64*4 = 2 MB base working set)
-_S_MAX = 512
-_LANE_TILE = 64
+# temporaries must stay inside VMEM. The lane tile must be a multiple of
+# 128 (Mosaic requires block minor dim % 128 == 0 — the original 64 failed
+# lowering outright), so S is capped at 256: 16*256*128*4 = 2 MB per block,
+# in + out + ~3 live stage temporaries ~= 10 MB of the 16 MB VMEM.
+_S_MAX = 256
+_LANE_TILE = 128
 
 
 @functools.cache
@@ -178,22 +181,38 @@ def _small(S: int, inverse: bool) -> _SmallNTT:
     return _SmallNTT(S, inverse)
 
 
-@functools.cache
-def _full_wpows_lm(n: int, inverse: bool):
-    """(n,) index table base: host powers of w (or w^{-1}) as a (16, n)
-    limb-major Montgomery array, built with O(log n) device muls.
+def _wpows_lm_traced(n: int, inverse: bool):
+    """(16, n) limb-major Montgomery table of w^0..w^{n-1}, built with
+    O(log n) TRACED device muls — deliberately not a host-side constant.
 
-    ensure_compile_time_eval + device_get: first use happens INSIDE the
-    ntt_limb jit trace, and a functools.cache of tracers would poison
-    every later call (the pss._ladder_tensors lesson)."""
-    from .ntt import _powers_device
-
+    The previous formulation cached a host numpy table and let jit embed
+    it: at n = 2^20 that baked a 64 MB literal into the program (135 MB of
+    StableHLO total), which is exactly the kind of monolith that wedged
+    the remote Mosaic service. Building it in-trace costs ~log2(n) muls of
+    (16, n) at runtime — negligible against the transform itself in the
+    prover, and XLA CSE dedups the rebuild across back-to-back transforms
+    in one program (the tables are pure functions of constants). Output is
+    redundant [0, 2p), a valid mul operand downstream."""
+    F = lfr()
     w = _w_root(n)
     if inverse:
         w = finv(w, R)
-    with jax.ensure_compile_time_eval():
-        tbl = jnp.transpose(_powers_device(w, n))  # (n,16) -> (16,n)
-    return jax.device_get(tbl)
+    logn = max(1, (n - 1).bit_length())
+    k = jnp.arange(n, dtype=jnp.uint32)
+    one = np.array(to_limbs(F.mont_r), np.uint32).reshape(NL, 1)
+    tbl = jnp.broadcast_to(jnp.asarray(one), (NL, n))
+    p_col = jnp.asarray(F.p_col)
+    for b in range(logn):
+        wb = np.array(
+            to_limbs(pow(w, 1 << b, R) * F.mont_r % R), np.uint32
+        ).reshape(NL, 1)
+        hit = ((k >> b) & 1) == 1
+        tbl = jnp.where(
+            hit[None, :],
+            F.mul(tbl, jnp.asarray(wb), p_col, unroll=False),
+            tbl,
+        )
+    return tbl
 
 
 def _ntt_rec(x, n: int, inverse: bool, L: int):
@@ -214,7 +233,7 @@ def _ntt_rec(x, n: int, inverse: bool, L: int):
     k1 = jnp.arange(A, dtype=jnp.uint32)[:, None]
     j2 = jnp.arange(B, dtype=jnp.uint32)[None, :]
     idx = (k1 * j2) % jnp.uint32(n)  # (A, B)
-    wp = _full_wpows_lm(n, inverse)  # (16, n)
+    wp = _wpows_lm_traced(n, inverse)  # (16, n)
     tw = jnp.take(wp, idx.reshape(-1), axis=1).reshape(NL, A, B, 1)
     y = F.mul(
         y.reshape(NL, -1),

@@ -2,12 +2,14 @@
 
 Diagnoses where the remote-TPU time goes before committing to the full
 bench.py program: (0) trivial dispatch, (1) the Pallas G1 add kernel at a
-few batch widths, (2) the fused NTT kernel, (3) a small tree MSM, then
-(4) the headline sizes. Each stage prints its own line immediately, so a
-wedged tunnel or a pathological compile is visible mid-run rather than as
-45 minutes of silence.
+few batch widths, (4) bit-exact MSM correctness vs the host oracle, (2)
+the fused NTT kernel, (3) a small tree MSM. Stages run IN THE ORDER GIVEN
+on the command line — the default puts the correctness gate before the
+big-compile throughput stages. Each stage prints its own line immediately,
+so a wedged tunnel or a pathological compile is visible mid-run rather
+than as 45 minutes of silence.
 
-Usage: python scripts/tpu_probe.py [--stages 0,1,2,3]
+Usage: python scripts/tpu_probe.py [--stages 0,1,4,2,3]
 """
 
 from __future__ import annotations
@@ -25,12 +27,160 @@ def emit(**kw):
     print(json.dumps(kw), flush=True)
 
 
+def _stage_trivial(jax, jnp, np, plat, args):
+    t = time.time()
+    x = jnp.arange(8192, dtype=jnp.uint32)
+    y = int((x * x + jnp.uint32(3)).sum())
+    emit(stage="trivial", ok=y > 0, t=round(time.time() - t, 1))
+
+
+def _stage_add_kernel(jax, jnp, np, plat, args):
+    from distributed_groth16_tpu.ops.constants import G1_GENERATOR
+    from distributed_groth16_tpu.ops.curve import g1
+    from distributed_groth16_tpu.ops.limb_kernels import lg1
+    from distributed_groth16_tpu.utils.benchtools import marginal_cost
+
+    g = lg1()
+    for log2n in (14, 17, 20):
+        n = 1 << log2n
+        t = time.time()
+        base = g1().encode([G1_GENERATOR])[0]
+        pts = jnp.broadcast_to(base.reshape(48, 1), (48, n))
+        add1 = g._pallas_add if plat == "tpu" else g._xla_add
+
+        @jax.jit
+        def run(p, k):
+            def body(i, acc):
+                return add1(acc, p)
+
+            return jax.lax.fori_loop(0, k, body, p)[0].sum(dtype=jnp.uint32)
+
+        def make(k: int, _run=run):
+            return lambda p: _run(p, k)
+
+        per = marginal_cost(make, (pts,))
+        emit(
+            stage="pallas_add",
+            log2n=log2n,
+            adds_per_sec=round(n / per),
+            per_call_ms=round(per * 1e3, 2),
+            compile_s=round(time.time() - t, 1),
+        )
+
+
+def _stage_ntt(jax, jnp, np, plat, args):
+    from distributed_groth16_tpu.ops.ntt_limb import ntt_limb
+    from distributed_groth16_tpu.utils.benchtools import marginal_cost
+
+    rng = np.random.default_rng(1)
+    for log2n in (12, 16, 20):
+        n = 1 << log2n
+        t = time.time()
+        x = jnp.asarray(rng.integers(0, 1 << 16, size=(16, n), dtype=np.uint32))
+
+        @jax.jit
+        def run(x, k):
+            def body(i, acc):
+                out = ntt_limb(x ^ i.astype(jnp.uint32), n, False)
+                return acc + out.sum(dtype=jnp.uint32)
+
+            return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+
+        def make(k: int, _run=run):
+            return lambda x: _run(x, k)
+
+        per = marginal_cost(make, (x,))
+        emit(
+            stage="ntt",
+            log2n=log2n,
+            per_call_ms=round(per * 1e3, 2),
+            compile_s=round(time.time() - t, 1),
+        )
+
+
+def _stage_msm_correctness(jax, jnp, np, plat, args):
+    # correctness on the REAL chip: the Pallas fast path has only ever
+    # executed under XLA:CPU (use_pallas gates it off-TPU); Mosaic's
+    # lowering of the u32 limb arithmetic must be validated bit-exactly
+    # before any throughput number means anything.
+    from distributed_groth16_tpu.ops import refmath as rm
+    from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
+    from distributed_groth16_tpu.ops.curve import g1
+    from distributed_groth16_tpu.ops.limb_kernels import msm_tree
+    from distributed_groth16_tpu.ops.msm import encode_scalars_std
+
+    rng = np.random.default_rng(3)
+    n = 512
+    t = time.time()
+    scal = [int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)]
+    pts = [rm.G1.scalar_mul(G1_GENERATOR, i + 1) for i in range(n)]
+    out = msm_tree(g1().encode(pts), encode_scalars_std(scal))
+    got = g1().decode(np.asarray(out)[None])[0]
+    want = rm.G1.msm(pts, scal)
+    emit(
+        stage="msm_correctness",
+        n=n,
+        ok=bool(got == want),
+        t=round(time.time() - t, 1),
+    )
+    if got != want:
+        emit(stage="msm_correctness_detail", got=str(got), want=str(want))
+        raise SystemExit(1)
+
+
+def _stage_msm_perf(jax, jnp, np, plat, args):
+    from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
+    from distributed_groth16_tpu.ops.curve import g1
+    from distributed_groth16_tpu.ops.limb_kernels import _msm_tree_jit, lg1
+    from distributed_groth16_tpu.ops.msm import encode_scalars_std
+    from distributed_groth16_tpu.utils.benchtools import marginal_cost
+
+    inner = _msm_tree_jit.__wrapped__
+    rng = np.random.default_rng(2)
+    n = 1 << args.msm_log2n
+    t = time.time()
+    scalars = encode_scalars_std(
+        [int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)]
+    )
+    points = jnp.broadcast_to(g1().encode([G1_GENERATOR])[0], (n, 3, 16))
+
+    @jax.jit
+    def run(points, scalars, k):
+        def body(i, acc):
+            sc = scalars ^ i.astype(jnp.uint32)
+            out = inner(lg1(), points, sc, 8, None)
+            return acc + out.sum(dtype=jnp.uint32)
+
+        return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+
+    def make(k: int):
+        return lambda points, scalars: run(points, scalars, k)
+
+    per = marginal_cost(make, (points, scalars))
+    emit(
+        stage="msm_tree",
+        log2n=args.msm_log2n,
+        muls_per_sec=round(n / per),
+        per_msm_ms=round(per * 1e3, 1),
+        compile_s=round(time.time() - t, 1),
+    )
+
+
+_STAGES = {
+    0: _stage_trivial,
+    1: _stage_add_kernel,
+    2: _stage_ntt,
+    3: _stage_msm_perf,
+    4: _stage_msm_correctness,
+}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--stages", default="0,1,2,3")
+    ap.add_argument("--stages", default="0,1,4,2,3")
     ap.add_argument("--msm-log2n", type=int, default=12)
     args = ap.parse_args()
-    stages = {int(s) for s in args.stages.split(",")}
+    order = [int(s) for s in args.stages.split(",")]
 
     t0 = time.time()
     import jax
@@ -44,112 +194,8 @@ def main() -> int:
     plat = jax.devices()[0].platform
     emit(stage="init", platform=plat, t=round(time.time() - t0, 1))
 
-    from distributed_groth16_tpu.utils.benchtools import marginal_cost
-
-    if 0 in stages:
-        t = time.time()
-        x = jnp.arange(8192, dtype=jnp.uint32)
-        y = int((x * x + jnp.uint32(3)).sum())
-        emit(stage="trivial", ok=y > 0, t=round(time.time() - t, 1))
-
-    if 1 in stages:
-        from distributed_groth16_tpu.ops.limb_kernels import lg1
-
-        g = lg1()
-        for log2n in (14, 17, 20):
-            n = 1 << log2n
-            t = time.time()
-            # random-ish valid points: broadcast generator, vary via double
-            from distributed_groth16_tpu.ops.constants import G1_GENERATOR
-            from distributed_groth16_tpu.ops.curve import g1
-
-            base = g1().encode([G1_GENERATOR])[0]
-            pts = jnp.broadcast_to(base.reshape(48, 1), (48, n))
-
-            def make(k: int):
-                @jax.jit
-                def run(p):
-                    acc = p
-                    for _ in range(k):
-                        acc = g._pallas_add(acc, p) if plat == "tpu" else g._xla_add(acc, p)
-                    return acc[0].sum(dtype=jnp.uint32)
-
-                return run
-
-            per = marginal_cost(make, (pts,))
-            emit(
-                stage="pallas_add",
-                log2n=log2n,
-                adds_per_sec=round(n / per),
-                per_call_ms=round(per * 1e3, 2),
-                compile_s=round(time.time() - t, 1),
-            )
-
-    if 2 in stages:
-        from distributed_groth16_tpu.ops.ntt_limb import ntt_limb
-
-        rng = np.random.default_rng(1)
-        for log2n in (12, 16, 20):
-            n = 1 << log2n
-            t = time.time()
-            x = jnp.asarray(
-                rng.integers(0, 1 << 16, size=(16, n), dtype=np.uint32)
-            )
-
-            def make(k: int):
-                @jax.jit
-                def run(x):
-                    acc = jnp.uint32(0)
-                    for i in range(k):
-                        out = ntt_limb(x ^ jnp.uint32(i), n, False)
-                        acc = acc + out.sum(dtype=jnp.uint32)
-                    return acc
-
-                return run
-
-            per = marginal_cost(make, (x,))
-            emit(
-                stage="ntt",
-                log2n=log2n,
-                per_call_ms=round(per * 1e3, 2),
-                compile_s=round(time.time() - t, 1),
-            )
-
-    if 3 in stages:
-        from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
-        from distributed_groth16_tpu.ops.curve import g1
-        from distributed_groth16_tpu.ops.limb_kernels import _msm_tree_jit, lg1
-        from distributed_groth16_tpu.ops.msm import encode_scalars_std
-
-        inner = _msm_tree_jit.__wrapped__
-        rng = np.random.default_rng(2)
-        n = 1 << args.msm_log2n
-        t = time.time()
-        scalars = encode_scalars_std(
-            [int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)]
-        )
-        points = jnp.broadcast_to(g1().encode([G1_GENERATOR])[0], (n, 3, 16))
-
-        def make(k: int):
-            @jax.jit
-            def run(points, scalars):
-                acc = jnp.uint32(0)
-                for i in range(k):
-                    sc = scalars ^ jnp.uint32(i)
-                    out = inner(lg1(), points, sc, 8, None)
-                    acc = acc + out.sum(dtype=jnp.uint32)
-                return acc
-
-            return run
-
-        per = marginal_cost(make, (points, scalars))
-        emit(
-            stage="msm_tree",
-            log2n=args.msm_log2n,
-            muls_per_sec=round(n / per),
-            per_msm_ms=round(per * 1e3, 1),
-            compile_s=round(time.time() - t, 1),
-        )
+    for s in order:
+        _STAGES[s](jax, jnp, np, plat, args)
     return 0
 
 
