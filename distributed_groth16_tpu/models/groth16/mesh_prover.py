@@ -60,8 +60,8 @@ def build_mesh_prover(pp: PackedSharingParams, m: int, mesh: Mesh,
     logm = m.bit_length() - 1
     dom = domain(m)
     dom2 = domain(2 * m)
-    wpows_m = dom._wpows
-    wpows_2m = dom2._wpows
+    wpows_m = dom._live_wpows()
+    wpows_2m = dom2._live_wpows()
     size_inv_m = dom._size_inv
 
     def step(qa, qb, qc, a_sh, ax_sh, s_q, u_q, v_q, w_q, h_q=None):

@@ -97,16 +97,15 @@ class JaxDomain:
         self.group_gen = pow(FR_GENERATOR, (R - 1) // size, R)
         self.group_gen_inv = finv(self.group_gen, R)
         F = fr()
-        self._perm = jnp.asarray(bitrev_perm(size))
-        self._wpows = _powers_device(self.group_gen, size)
-        self._size_inv = F.encode([finv(size, R)])[0]
-        if self.offset != 1:
-            off_inv = finv(self.offset, R)
-            self._off_pows = _powers_device(self.offset, size)
-            self._off_inv_pows = _powers_device(off_inv, size)
-        else:
-            self._off_pows = None
-            self._off_inv_pows = None
+        self._perm = jnp.asarray(bitrev_perm(size))  # host-built: no tracer
+        self._size_inv = F.encode([finv(size, R)])[0]  # host-built too
+        # The device root/offset tables are built LAZILY, first time they
+        # are needed outside a trace (_live_* below): domain() is
+        # functools.cached, and if the first construction happened inside a
+        # jit trace an eager _powers_device here would cache TRACERS that
+        # poison every later call (the _SmallNTT "numpy, NOT jnp" lesson).
+        self._wpows_cached = None
+        self._off_cached: dict[bool, jnp.ndarray] = {}
 
     def elements(self) -> list[int]:
         out, acc = [], self.offset
@@ -125,9 +124,11 @@ class JaxDomain:
     # cached concrete tables.
 
     def _live_wpows(self):
-        if not _tracing_active():
-            return self._wpows
-        return _powers_device(self.group_gen, self.size)
+        if _tracing_active():
+            return _powers_device(self.group_gen, self.size)
+        if self._wpows_cached is None:
+            self._wpows_cached = _powers_device(self.group_gen, self.size)
+        return self._wpows_cached
 
     def _live_perm(self):
         if not _tracing_active():
@@ -137,10 +138,12 @@ class JaxDomain:
     def _live_off(self, inverse: bool):
         if self.offset == 1:
             return None
-        if not _tracing_active():
-            return self._off_inv_pows if inverse else self._off_pows
         base = finv(self.offset, R) if inverse else self.offset
-        return _powers_device(base, self.size)
+        if _tracing_active():
+            return _powers_device(base, self.size)
+        if inverse not in self._off_cached:
+            self._off_cached[inverse] = _powers_device(base, self.size)
+        return self._off_cached[inverse]
 
     def fft(self, coeffs):
         """Evaluate: (..., k<=n, 16) coeffs -> (..., n, 16) evals."""

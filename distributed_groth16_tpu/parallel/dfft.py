@@ -178,7 +178,7 @@ async def _d_transform(
     assert dom.offset == 1, "d_fft runs on plain (non-coset) domains"
     logm = m.bit_length() - 1
     logl = pp.l.bit_length() - 1
-    wpows = domain(m)._wpows
+    wpows = domain(m)._live_wpows()
     F = fr()
     log.debug("d_%sfft: party %d stage-1 m=%d (sid=%d)",
               "i" if inverse else "", net.party_id, m, sid)

@@ -181,6 +181,10 @@ def main() -> int:
     ap.add_argument("--msm-log2n", type=int, default=12)
     args = ap.parse_args()
     order = [int(s) for s in args.stages.split(",")]
+    unknown = [s for s in order if s not in _STAGES]
+    if unknown:
+        emit(stage="warn", unknown_stages=unknown, known=sorted(_STAGES))
+        order = [s for s in order if s in _STAGES]
 
     t0 = time.time()
     import jax

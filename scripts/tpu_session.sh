@@ -1,13 +1,42 @@
 #!/bin/bash
-# One-shot TPU measurement session: run the moment the axon tunnel answers.
-# 1. bench.py (tree-MSM 2^16 + 2^20 lanes + NTT 2^20) -> JSON line
-# 2. single-node sha256 prove wall-clock on the chip (BASELINE config 1)
-# Usage: bash scripts/tpu_session.sh [logfile]
+# One-shot TPU measurement session: runs the full on-chip program in value
+# order, each stage logged and time-bounded, continuing past failures.
+# Designed to be fired automatically the moment the tunnel recovers (the
+# window may be short): small compiles first, so a wedge costs the least.
+#
+# Usage: scripts/tpu_session.sh [logdir]   (default /tmp/tpu_session)
 set -u
-LOG=${1:-/tmp/tpu_session.log}
 cd "$(dirname "$0")/.."
-echo "=== bench.py ($(date -u +%FT%TZ)) ===" | tee -a "$LOG"
-timeout 3600 python bench.py 2>&1 | tee -a "$LOG"
-echo "=== sha256 e2e single-node on chip ===" | tee -a "$LOG"
-timeout 7200 python examples/sha256.py --skip-mpc 2>&1 | tail -20 | tee -a "$LOG"
-echo "=== done ($(date -u +%FT%TZ)) ===" | tee -a "$LOG"
+LOG=${1:-/tmp/tpu_session}
+mkdir -p "$LOG"
+stamp() { date -u +%H:%M:%S; }
+note() { echo "$(stamp) $*" | tee -a "$LOG/session.log"; }
+
+note "=== TPU session start"
+
+# A: tunnel sanity + add-kernel throughput + bit-exact MSM correctness +
+#    2^12 MSM perf (same program bench stage 1 will reuse from the cache)
+note "stage A: probe 0,1,4,3 @2^12"
+timeout 2700 python scripts/tpu_probe.py --stages 0,1,4,3 --msm-log2n 12 \
+  > "$LOG/probe.json" 2> "$LOG/probe.log"
+note "stage A exit=$? ($(tail -c 200 "$LOG/probe.json" 2>/dev/null | tr -d '\n'))"
+
+# B: the round bench — staged 12/16/20 sweep + NTT, watchdog-protected
+note "stage B: bench.py"
+DG16_BENCH_BUDGET_S=2700 timeout 3300 python bench.py \
+  > "$LOG/bench.json" 2> "$LOG/bench.log"
+note "stage B exit=$? ($(tail -c 300 "$LOG/bench.json" 2>/dev/null | tr -d '\n'))"
+
+# C: packing micro-bench at 2^15 (VERDICT #6 done-bar: packing <= prove)
+note "stage C: profile_packing @2^15"
+timeout 2700 python scripts/profile_packing.py --log2-m 15 \
+  > "$LOG/packing.json" 2> "$LOG/packing.log"
+note "stage C exit=$? ($(tail -c 200 "$LOG/packing.json" 2>/dev/null | tr -d '\n'))"
+
+# D: end-to-end sha256 single-node prove on the chip (BASELINE config 1)
+note "stage D: sha256 e2e --skip-mpc"
+timeout 5400 python examples/sha256.py --skip-mpc \
+  > "$LOG/sha256.log" 2>&1
+note "stage D exit=$? ($(tail -c 300 "$LOG/sha256.log" 2>/dev/null | tr -d '\n'))"
+
+note "=== TPU session done"
