@@ -25,7 +25,8 @@ note "stage A exit=$? ($(tail -c 200 "$LOG/probe.json" 2>/dev/null | tr -d '\n')
 note "stage B: bench.py"
 DG16_BENCH_BUDGET_S=2700 timeout 3300 python bench.py \
   > "$LOG/bench.json" 2> "$LOG/bench.log"
-note "stage B exit=$? ($(tail -c 300 "$LOG/bench.json" 2>/dev/null | tr -d '\n'))"
+b_exit=$?
+note "stage B exit=$b_exit ($(tail -c 300 "$LOG/bench.json" 2>/dev/null | tr -d '\n'))"
 
 # C: packing micro-bench at 2^15 (VERDICT #6 done-bar: packing <= prove)
 note "stage C: profile_packing @2^15"
@@ -42,7 +43,7 @@ note "stage D exit=$? ($(tail -c 300 "$LOG/sha256.log" 2>/dev/null | tr -d '\n')
 # E: only if the fori bench completed — measure the unrolled-body steady
 # state too (removes the masked-extraction tax at a higher compile cost);
 # whichever is faster becomes the round-5 default.
-if grep -q '"platform": "tpu"' "$LOG/bench.json" 2>/dev/null; then
+if [ "$b_exit" -eq 0 ] && grep -q '"platform": "tpu"' "$LOG/bench.json" 2>/dev/null; then
   note "stage E: bench.py DG16_PALLAS_ROLL=unroll"
   DG16_PALLAS_ROLL=unroll DG16_BENCH_BUDGET_S=2400 timeout 3000 python bench.py \
     > "$LOG/bench_unroll.json" 2> "$LOG/bench_unroll.log"
