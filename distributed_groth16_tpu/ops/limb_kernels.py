@@ -47,17 +47,18 @@ def _pallas_roll_mode() -> str:
         Fastest steady state, but with ~30 kernel instances per MSM program
         the remote Mosaic compile of the monolithic tree at 2^16 ran 40+
         minutes without completing (2026-07-31, v5e tunnel).
-    'fori':   CIOS rounds + carry chains as lax.fori_loop with masked
-        sublane row-extraction — ~4x smaller StableHLO than 'unroll'
-        (2^14 tree program: 1.2 MB vs 4.7 MB) at a modest vector-op tax.
+    'fori':   CIOS rounds + carry chains as lax.fori_loop with
+        concat-rotate row access (carry a rotated copy, read row 0 by
+        STATIC slice — dynamic_slice and lax.scan xs-slicing both fail
+        Mosaic lowering here, and masked iota-reduction extraction costs
+        ~4 full-tile ops per access) — ~4x smaller StableHLO than
+        'unroll' (2^14 tree program: 1.2 MB vs 4.7 MB).
     'scan':   the unroll=False lax.scan formulation. DOES NOT LOWER in
         this jax's Mosaic (_scan_lowering_rule raises NotImplementedError
-        for extensive outputs) — kept only as documentation of the
+        for extensive inputs/outputs) — kept only as documentation of the
         measurement; selecting it fails at first kernel trace.
 
-    Similarly DG16_PALLAS_EXTRACT=dyn (dynamic_slice row extraction) is
-    unimplemented in Mosaic TPU lowering; 'mask' is the working mode.
-    All three formulations are bit-identical on the XLA fallback
+    All formulations are bit-identical on the XLA fallback
     (tests/test_limb_roll.py).
     """
     return os.environ.get("DG16_PALLAS_ROLL", "fori")
@@ -90,27 +91,12 @@ def use_pallas() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _extract_mode() -> str:
-    """Sublane row extraction inside fori bodies: 'mask' (iota+select+
-    reduce — always lowers) or 'dyn' (dynamic_slice on the sublane axis)."""
-    return os.environ.get("DG16_PALLAS_EXTRACT", "mask")
-
-
-def _row(a, i):
-    """Row i of (k, n) as (1, n); i may be a traced loop index."""
-    if _extract_mode() == "dyn":
-        return jax.lax.dynamic_slice_in_dim(a, i, 1, axis=0)
-    iota = jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
-    picked = jnp.where(iota == i, a, jnp.uint32(0)).astype(jnp.int32)
-    return jnp.sum(picked, axis=0, keepdims=True).astype(jnp.uint32)
-
-
-def _setrow(out, i, row):
-    """out with row i replaced by row (1, n); i may be traced."""
-    if _extract_mode() == "dyn":
-        return jax.lax.dynamic_update_slice_in_dim(out, row, i, axis=0)
-    iota = jax.lax.broadcasted_iota(jnp.int32, out.shape, 0)
-    return jnp.where(iota == i, row, out)
+def _rot(a):
+    """Rotate rows up by one: row 0 moves to the bottom. Static slices +
+    concat only — both lower in Mosaic (dynamic_slice and masked
+    iota-reduction extraction do not / cost ~4 full-tile ops per access).
+    fori bodies carry a rotated copy and always read row 0."""
+    return jnp.concatenate([a[1:], a[0:1]], axis=0)
 
 
 class LimbField:
@@ -132,7 +118,7 @@ class LimbField:
     # kernel instances wedged the remote Mosaic service for 40+ min on the
     # 2^16 tree program); False = `lax.scan`-rolled for the plain-XLA
     # fallback (unrolled 3k-op graphs made CPU test compiles minutes-long);
-    # "fori" = `lax.fori_loop`-rolled with masked sublane extraction, the
+    # "fori" = `lax.fori_loop`-rolled with concat-rotate row access, the
     # Pallas compile-friendly middle ground (~10x smaller bodies).
 
     def carry(self, v, unroll=True):
@@ -143,13 +129,20 @@ class LimbField:
         """
         v = v[:NL]
         if unroll == "fori":
+            # out self-assembles by appending each carried row at the
+            # bottom: after 16 iterations rows sit in order 0..15.
             def body(i, st):
-                out, c = st
-                t = _row(v, i) + c
-                return _setrow(out, i, t & MASK), t >> LIMB_BITS
+                out, c, vr = st
+                t = vr[0:1] + c
+                return (
+                    jnp.concatenate([out[1:], t & MASK], axis=0),
+                    t >> LIMB_BITS,
+                    _rot(vr),
+                )
 
-            out, _ = jax.lax.fori_loop(
-                0, NL, body, (jnp.zeros_like(v), jnp.zeros_like(v[0:1]))
+            out, _, _ = jax.lax.fori_loop(
+                0, NL, body,
+                (jnp.zeros_like(v), jnp.zeros_like(v[0:1]), v),
             )
             return out
         if not unroll:
@@ -173,12 +166,18 @@ class LimbField:
             m_col = jnp.asarray(m_col)
 
             def body(i, st):
-                d, b = st
-                t = _row(a, i) - _row(m_col, i) - b
-                return _setrow(d, i, t & MASK), t >> 31
+                d, b, ar, mr = st
+                t = ar[0:1] - mr[0:1] - b
+                return (
+                    jnp.concatenate([d[1:], t & MASK], axis=0),
+                    t >> 31,
+                    _rot(ar),
+                    _rot(mr),
+                )
 
-            d, b = jax.lax.fori_loop(
-                0, NL, body, (jnp.zeros_like(a), jnp.zeros_like(a[0:1]))
+            d, b, _, _ = jax.lax.fori_loop(
+                0, NL, body,
+                (jnp.zeros_like(a), jnp.zeros_like(a[0:1]), a, m_col),
             )
             return jnp.where(b == 0, d, a)
         if not unroll:
@@ -209,12 +208,18 @@ class LimbField:
             p2 = jnp.asarray(p2)
 
             def body(i, st):
-                out, brw = st
-                t = _row(p2, i) - _row(b, i) - brw
-                return _setrow(out, i, t & MASK), t >> 31
+                out, brw, br, pr = st
+                t = pr[0:1] - br[0:1] - brw
+                return (
+                    jnp.concatenate([out[1:], t & MASK], axis=0),
+                    t >> 31,
+                    _rot(br),
+                    _rot(pr),
+                )
 
-            out, _ = jax.lax.fori_loop(
-                0, NL, body, (jnp.zeros_like(b), jnp.zeros_like(b[0:1]))
+            out, _, _, _ = jax.lax.fori_loop(
+                0, NL, body,
+                (jnp.zeros_like(b), jnp.zeros_like(b[0:1]), b, p2),
             )
             return out
         if not unroll:
@@ -267,9 +272,11 @@ class LimbField:
 
         v0 = jnp.zeros((NL + 1, n), jnp.uint32)
         if unroll == "fori":
-            v = jax.lax.fori_loop(
-                0, NL, lambda i, v: step(v, _row(a, i)), v0
-            )
+            def body(i, st):
+                v, ar = st
+                return step(v, ar[0:1]), _rot(ar)
+
+            v, _ = jax.lax.fori_loop(0, NL, body, (v0, a))
             return self.carry(v, unroll="fori")
         if not unroll:
             v, _ = jax.lax.scan(

@@ -14,17 +14,28 @@ import os
 
 
 def machine_tag() -> str:
-    """CPU-feature fingerprint used as the compile-cache partition key."""
+    """Compile-cache partition key: CPU features + the env knobs that
+    change XLA's chosen target config.
+
+    cpuinfo alone proved insufficient: two same-host processes (one with
+    the axon plugin env, one plain CPU) wrote entries into one partition
+    whose LLVM target features disagreed (+prefer-no-scatter/-gather),
+    and the AOT loader warns the mismatch "could lead to SIGILL" on load —
+    observed 2026-07-31 from a cache shared across backend configs."""
+    parts = [""]
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
                 if line.startswith("flags"):
-                    return hashlib.sha1(line.encode()).hexdigest()[:12]
+                    parts[0] = line
+                    break
     except OSError:
-        pass
-    import platform
+        import platform
 
-    return hashlib.sha1(platform.processor().encode()).hexdigest()[:12]
+        parts[0] = platform.processor()
+    for var in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS"):
+        parts.append(f"{var}={os.environ.get(var, '')}")
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:12]
 
 
 def disable_compile_cache(jax) -> None:
@@ -66,10 +77,11 @@ def setup_compile_cache(
     if os.environ.get("DG16_NO_JAX_CACHE"):
         disable_compile_cache(jax)
         return ""
-    # v3: versioned partition — pre-v3 partitions can hold entries whose
-    # AOT load crashes the process (see disable_compile_cache); a version
-    # bump orphans them wholesale
-    path = os.path.join(root, ".jax_cache", "v3-" + machine_tag())
+    # v4: versioned partition — earlier partitions can hold entries whose
+    # AOT load crashes the process (see disable_compile_cache) or, as of
+    # v3, entries from mixed backend configs with clashing target
+    # features; a version bump orphans them wholesale
+    path = os.path.join(root, ".jax_cache", "v4-" + machine_tag())
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update(
         "jax_persistent_cache_min_compile_time_secs", min_compile_seconds

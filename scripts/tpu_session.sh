@@ -41,8 +41,8 @@ timeout 5400 python examples/sha256.py --skip-mpc \
 note "stage D exit=$? ($(tail -c 300 "$LOG/sha256.log" 2>/dev/null | tr -d '\n'))"
 
 # E: only if the fori bench completed — measure the unrolled-body steady
-# state too (removes the masked-extraction tax at a higher compile cost);
-# whichever is faster becomes the round-5 default.
+# state too (removes the fori loop overhead at a much higher compile
+# cost); whichever is faster becomes the round-5 default.
 if [ "$b_exit" -eq 0 ] && grep -q '"platform": "tpu"' "$LOG/bench.json" 2>/dev/null; then
   note "stage E: bench.py DG16_PALLAS_ROLL=unroll"
   DG16_PALLAS_ROLL=unroll DG16_BENCH_BUDGET_S=2400 timeout 3000 python bench.py \

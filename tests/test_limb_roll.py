@@ -2,7 +2,7 @@
 bit-identical: Pallas kernels default to the `fori` (lax.fori_loop) bodies
 for compile-time reasons (ops/limb_kernels._pallas_roll_mode), but the CPU
 suite otherwise only exercises the `scan` XLA fallback — without this test a
-fori/extract regression would surface only as wrong proofs on the TPU."""
+fori/rotate regression would surface only as wrong proofs on the TPU."""
 
 import os
 import sys
@@ -32,9 +32,7 @@ def _operands(n=64, seed=0):
     return F, a, b
 
 
-@pytest.mark.parametrize("extract", ["mask", "dyn"])
-def test_field_fori_matches_unrolled(extract, monkeypatch):
-    monkeypatch.setenv("DG16_PALLAS_EXTRACT", extract)
+def test_field_fori_matches_unrolled():
     F, a, b = _operands()
     p, p2 = jnp.asarray(F.p_col), jnp.asarray(F.p2_col)
     cases = {
@@ -49,7 +47,7 @@ def test_field_fori_matches_unrolled(extract, monkeypatch):
         u = np.asarray(jax.jit(lambda: fn(True))())
         for mode in (False, "fori"):
             r = np.asarray(jax.jit(lambda: fn(mode))())
-            assert (u == r).all(), (name, mode, extract)
+            assert (u == r).all(), (name, mode)
 
 
 @pytest.mark.parametrize("group", ["g1", "g2"])
