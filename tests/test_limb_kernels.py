@@ -95,6 +95,24 @@ def test_msm_tree_matches_reference():
     assert got == want
 
 
+def test_msm_tree_window_groups():
+    """Explicit window_group < W exercises the grouped-window loop — the
+    path the 2^20 bench takes (npad > 2^17 auto-selects groups of 8) but
+    that the auto heuristic never triggers at test sizes."""
+    C = g1()
+    rng = np.random.default_rng(14)
+    n = 96
+    ks = [int(x) for x in rng.integers(1, 2**61, size=n)]
+    pts = [rm.G1.scalar_mul(G1_GENERATOR, k) for k in ks]
+    scs = [int.from_bytes(rng.bytes(40), "little") % R for _ in range(n)]
+    P = C.encode(pts)
+    sc = encode_scalars_std(scs)
+    want = rm.G1.msm(pts, scs)
+    for wg in (2, 3):  # W=64 at c=4: even and ragged group splits
+        got = C.decode(msm_tree(P, sc, 4, wg)[None])[0]
+        assert got == want, wg
+
+
 def test_msm_routing_forced(monkeypatch):
     monkeypatch.setenv("DG16_FORCE_TREE_MSM", "1")
     C = g1()
