@@ -39,17 +39,38 @@ ARKWORKS_CPU_MSM_PER_SEC = 1.0e6  # documented ballpark, see module docstring
 
 _PRINTED = False
 _PRINT_LOCK = threading.Lock()
+# Captured at import, NOT via limb_kernels: _emit runs from the SIGTERM
+# handler, where a module import could deadlock on the import lock if the
+# main thread holds it (and limb_kernels reads the env once at import
+# anyway, so this string is authoritative for the process).
+_ROLL_MODE = os.environ.get("DG16_PALLAS_ROLL", "fori")
 
 
-def _emit(res: dict, stage_s: dict, platform: str) -> None:
-    """Print the single JSON line (idempotent; safe from watchdog/handler)."""
+def _emit(
+    res: dict, stage_s: dict, platform: str, from_signal: bool = False
+) -> None:
+    """Print the single JSON line (idempotent; safe from watchdog/handler).
+
+    The lock is held across flag-set AND print, so thread-vs-thread races
+    stay one-line. The signal path uses a BOUNDED acquire: if SIGTERM
+    interrupts the very frame that holds the lock, an unbounded acquire
+    would deadlock the handler and os._exit would never run — after the
+    timeout we print anyway (the handler exits the process immediately
+    after, so the interrupted frame can never produce a duplicate)."""
     global _PRINTED
-    with _PRINT_LOCK:
+    got = _PRINT_LOCK.acquire(timeout=5.0) if from_signal \
+        else _PRINT_LOCK.acquire()
+    try:
         if _PRINTED:
             return
         _PRINTED = True
-    from distributed_groth16_tpu.ops.limb_kernels import _pallas_roll_mode
+        _do_emit(res, stage_s, platform)
+    finally:
+        if got:
+            _PRINT_LOCK.release()
 
+
+def _do_emit(res: dict, stage_s: dict, platform: str) -> None:
     out = {
         "metric": res.get("metric", "msm_g1_scalar_muls_per_sec"),
         "value": res.get("value", 0),
@@ -61,7 +82,7 @@ def _emit(res: dict, stage_s: dict, platform: str) -> None:
         "platform": platform,
         "method": "marginal (t3-t1)/2, jitted K-loop, host-sync",
         "stage_seconds": dict(stage_s),
-        "pallas_roll": _pallas_roll_mode(),
+        "pallas_roll": _ROLL_MODE,
         **{k: v for k, v in res.items() if k not in ("metric", "value")},
     }
     print(json.dumps(out), flush=True)
@@ -182,7 +203,10 @@ def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
     signal.signal(
         signal.SIGTERM,
-        lambda *a: (_emit(res, stage_s, platform), os._exit(0)),
+        lambda *a: (
+            _emit(res, stage_s, platform, from_signal=True),
+            os._exit(0),
+        ),
     )
 
     sizes = [12, 16, 20] if platform == "tpu" else [12]

@@ -43,6 +43,14 @@ class ProvingKey:
     l_query: jnp.ndarray  # (num_witness, 3, 16)
     domain_size: int
     num_instance: int
+    # Dealer-side discrete logs of the query arrays (QueryScalars in
+    # proving_key.py), kept ONLY when this key was produced by an
+    # in-process setup(). They let pack_proving_key run in the FIELD
+    # (NTT pack + windowed fixed-base) instead of in the exponent —
+    # the r4 CPU bottleneck (84% of million-2^13 wall-clock). Not
+    # persisted by save(): a loaded key (external CRS) has None and
+    # packs via the in-exponent ladder as before.
+    query_scalars: object | None = None
 
     @property
     def num_wires(self) -> int:

@@ -164,6 +164,20 @@ def setup(r1cs: R1CS, seed: int = 42) -> ProvingKey:
         delta_g2=g2().decode(delta_g2_d),
         gamma_abc_g1=list(C1.decode(gamma_abc)),
     )
+    # The dealer keeps the query discrete logs: pack_proving_key then
+    # shards the CRS in the FIELD (device NTT pack + windowed fixed-base,
+    # proving_key.py) instead of point ladders — same shares, ~W/nbits
+    # the curve work (the r4 84%-of-wall-clock bottleneck).
+    from .proving_key import QueryScalars
+
+    F = fr()
+    with phase("setup: query scalar encode"):
+        query_scalars = QueryScalars(
+            a=F.encode(u),
+            b=F.encode(v),
+            l=F.encode(l_query_s),
+            h=h_scal,
+        )
     return ProvingKey(
         vk=vk,
         beta_g1=beta_g1_d,
@@ -175,4 +189,5 @@ def setup(r1cs: R1CS, seed: int = 42) -> ProvingKey:
         l_query=l_query,
         domain_size=m,
         num_instance=ni,
+        query_scalars=query_scalars,
     )

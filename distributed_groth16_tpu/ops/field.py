@@ -69,8 +69,12 @@ class PrimeField:
 
     # -- host <-> device conversion -------------------------------------------
 
-    def encode(self, values) -> jnp.ndarray:
-        """Python ints / nested lists -> Montgomery limb array (host-side)."""
+    def encode_np(self, values) -> np.ndarray:
+        """Python ints / nested lists -> Montgomery limb array as NUMPY.
+        Safe to build and cache from inside a jit trace (a numpy array is
+        a plain constant, never a tracer); use for tables stored on
+        long-lived cached objects (JaxDomain) that may first be
+        constructed under a trace."""
         arr = np.asarray(values, dtype=object)
         p, r = self.p, self.mont_r
         nb = 2 * self.nl
@@ -78,7 +82,11 @@ class PrimeField:
             ((int(v) % p) * r % p).to_bytes(nb, "little") for v in arr.reshape(-1)
         )
         out = np.frombuffer(buf, dtype="<u2").astype(np.uint32)
-        return jnp.asarray(out.reshape(arr.shape + (self.nl,)))
+        return out.reshape(arr.shape + (self.nl,))
+
+    def encode(self, values) -> jnp.ndarray:
+        """Python ints / nested lists -> Montgomery limb array (host-side)."""
+        return jnp.asarray(self.encode_np(values))
 
     def decode(self, x) -> np.ndarray:
         """Montgomery limb array -> numpy object array of Python ints."""

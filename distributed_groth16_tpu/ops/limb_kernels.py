@@ -60,8 +60,18 @@ def _pallas_roll_mode() -> str:
 
     All formulations are bit-identical on the XLA fallback
     (tests/test_limb_roll.py).
+
+    The env var is read ONCE at module import: the chosen mode is baked
+    into process-global caches (_SmallNTT cached properties,
+    LimbGroup._horner functools.cache, jit caches), so a mid-process env
+    change could not take effect anyway — capturing at import makes the
+    knob honestly process-start-only (tpu_session.sh already launches a
+    fresh process per mode).
     """
-    return os.environ.get("DG16_PALLAS_ROLL", "fori")
+    return _ROLL_MODE
+
+
+_ROLL_MODE = os.environ.get("DG16_PALLAS_ROLL", "fori")
 
 
 def kernel_roll_mode():

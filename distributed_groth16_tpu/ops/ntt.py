@@ -28,11 +28,22 @@ from .refmath import finv
 
 
 def _tracing_active() -> bool:
-    """True when called under a jit/vmap trace (public jax.core lost
-    trace_state_clean in this version; the _src alias remains)."""
-    from jax._src.core import trace_state_clean
+    """True when called under a jit/vmap trace. Prefers the private
+    trace_state_clean (public jax.core lost it in this version); if a
+    future jax drops the _src alias too, falls back to probing whether
+    arithmetic on a concrete array yields a Tracer — and on any probe
+    failure conservatively reports True (the in-trace path is always
+    correct, just slightly more device work)."""
+    try:
+        from jax._src.core import trace_state_clean
 
-    return not trace_state_clean()
+        return not trace_state_clean()
+    except ImportError:
+        try:
+            probe = jnp.zeros((), dtype=jnp.int32) + 0
+            return isinstance(probe, jax.core.Tracer)
+        except Exception:
+            return True
 
 
 def _bitrev(n: int, xp):
@@ -97,14 +108,21 @@ class JaxDomain:
         self.group_gen = pow(FR_GENERATOR, (R - 1) // size, R)
         self.group_gen_inv = finv(self.group_gen, R)
         F = fr()
-        self._perm = jnp.asarray(bitrev_perm(size))  # host-built: no tracer
-        self._size_inv = F.encode([finv(size, R)])[0]  # host-built too
+        # NUMPY, not jnp: domain() is functools-cached, and the first
+        # construction may happen inside a jit trace — jnp.asarray under
+        # an active trace yields a tracer-backed constant that would be
+        # cached and poison every later eager fft/ifft. numpy arrays are
+        # plain constants in both worlds (jnp.take accepts numpy indices;
+        # F.mul accepts a numpy operand).
+        self._perm = bitrev_perm(size)
+        self._size_inv = F.encode_np([finv(size, R)])[0]
         # The device root/offset tables are built LAZILY, first time they
         # are needed outside a trace (_live_* below): domain() is
         # functools.cached, and if the first construction happened inside a
         # jit trace an eager _powers_device here would cache TRACERS that
         # poison every later call (the _SmallNTT "numpy, NOT jnp" lesson).
         self._wpows_cached = None
+        self._perm_cached = None
         self._off_cached: dict[bool, jnp.ndarray] = {}
 
     def elements(self) -> list[int]:
@@ -132,7 +150,9 @@ class JaxDomain:
 
     def _live_perm(self):
         if not _tracing_active():
-            return self._perm
+            if self._perm_cached is None:
+                self._perm_cached = jnp.asarray(self._perm)
+            return self._perm_cached
         return _bitrev_traced(self.size)
 
     def _live_off(self, inverse: bool):

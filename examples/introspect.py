@@ -1,0 +1,131 @@
+"""Circuit introspection + single-node prove — the reference's
+groth16/examples/test.rs:1-261 analog.
+
+test.rs loads the sha256 circom fixture, prints constraint-system
+statistics (matrix row counts, assignment length, input/constraint
+counts, struct sizes), builds a SECOND setup-only circuit from the same
+config (no inputs pushed) and compares its stats, then times a proof
+"without MPC" (create_proof_with_reduction_and_matrices, r = s = 0) and
+pairing-verifies it twice (once through a reconstructed Proof struct).
+
+This analog does the same over the mycircuit artifacts (the largest
+circuit the reference ships with BOTH .wasm and .r1cs checked in;
+test.rs's own sha256 fixture lacks a compiled .r1cs). Stats are byte
+sizes of the device tensors rather than Rust mem::size_of, which is the
+meaningful equivalent here.
+
+Run: python examples/introspect.py [--a 3] [--b 11]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+VECTORS = "/root/reference/ark-circom/test-vectors"
+
+if os.environ.get("DG16_EXAMPLE_TPU") != "1":
+    # same dance as tests/conftest.py: the experimental TPU plugin hooks
+    # backend discovery at init and hangs when its tunnel is down; strip
+    # it and pin CPU before anything touches a backend
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _nbytes(x) -> int:
+    import numpy as np
+
+    return np.asarray(x).nbytes
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--a", type=int, default=3)
+    ap.add_argument("--b", type=int, default=11)
+    args = ap.parse_args()
+
+    from distributed_groth16_tpu.frontend.builder import (
+        CircomBuilder,
+        CircomConfig,
+    )
+    from distributed_groth16_tpu.models.groth16 import setup, verify
+    from distributed_groth16_tpu.models.groth16.prove import prove_single
+    from distributed_groth16_tpu.models.groth16.qap import CompiledR1CS
+    from distributed_groth16_tpu.ops.field import fr
+
+    wasm = f"{VECTORS}/mycircuit.wasm"
+    r1cs_path = f"{VECTORS}/mycircuit.r1cs"
+    if not (os.path.exists(wasm) and os.path.exists(r1cs_path)):
+        print("fixture artifacts not found; nothing to introspect")
+        return 0
+
+    cwd = os.getcwd()
+    print(f"Current working directory: {cwd}")
+
+    cfg = CircomConfig(wasm, r1cs_path, sanity_check=True)
+    builder = CircomBuilder(cfg)
+    builder.push_input("a", args.a)
+    builder.push_input("b", args.b)
+    circuit = builder.build()
+    full_assignment = circuit.witness
+    r1cs = circuit.r1cs
+
+    # second, setup-only circuit from the same config (test.rs builder2:
+    # no inputs pushed, no witness computed)
+    builder2 = CircomBuilder(cfg)
+    circuit2 = builder2.setup()
+    assert circuit2.witness is None
+
+    pk = setup(r1cs, seed=42)
+
+    # -- introspection block (test.rs:171-205) -----------------------------
+    pk_bytes = sum(
+        _nbytes(t)
+        for t in (
+            pk.a_query, pk.b_g1_query, pk.b_g2_query, pk.h_query, pk.l_query
+        )
+    )
+    print(f"Size of pk (query tensors): {pk_bytes} bytes")
+    print(f"Size of vk: {len(pk.vk.gamma_abc_g1)} gamma_abc points")
+    print(f"Matrix A len: {len(r1cs.a)}")
+    print(f"Matrix B len: {len(r1cs.b)}")
+    print(f"Matrix C len: {len(r1cs.c)}")
+    nnz = sum(len(row) for row in r1cs.a + r1cs.b + r1cs.c)
+    print(f"Matrix nonzeros (A+B+C): {nnz}")
+    print(f"Full assignment len: {len(full_assignment)}")
+    print(f"Number of inputs: {r1cs.num_instance}")
+    print(f"Number of constraints: {r1cs.num_constraints}")
+    print(f"Number of inputs2: {circuit2.r1cs.num_instance}")
+    print(f"Number of constraints2: {circuit2.r1cs.num_constraints}")
+
+    # -- proof without MPC, r = s = 0 (test.rs:211-231) --------------------
+    comp = CompiledR1CS(r1cs)
+    z_mont = fr().encode(full_assignment)
+    t0 = time.time()
+    proof = prove_single(pk, comp, z_mont, r=0, s=0)
+    dt = time.time() - t0
+    print(f"Proof: a={proof.a} b={proof.b} c={proof.c}")
+    print(f"Time taken to create proof without MPC: {dt:.3f}s")
+
+    public = full_assignment[1 : r1cs.num_instance]
+    ok1 = verify(pk.vk, proof, public)
+    assert ok1, "Proof verification failed!"
+    # reconstructed-proof second verification (test.rs:246-260)
+    from distributed_groth16_tpu.models.groth16.keys import Proof
+
+    proof2 = Proof(a=proof.a, b=proof.b, c=proof.c)
+    ok2 = verify(pk.vk, proof2, public)
+    assert ok2, "Reconstructed proof verification failed!"
+    print("both verifications passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

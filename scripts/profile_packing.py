@@ -42,7 +42,8 @@ def main() -> int:
 
     plat = jax.devices()[0].platform
     m = 1 << args.log2_m
-    pp = PackedSharingParams(args.n, args.l)
+    assert args.n == 4 * args.l, "PSS requires n = 4l"
+    pp = PackedSharingParams(args.l)
     C1 = g1()
 
     # m points arranged (m/l, l) for pack-consecutive semantics
@@ -59,6 +60,24 @@ def main() -> int:
     np.asarray(out)
     warm = time.time() - t0
 
+    # scalar route (r5): what the same m costs when the dealer knows the
+    # discrete logs — field-NTT pack + windowed fixed-base
+    # (models/groth16/proving_key.py _pack_query_scalars)
+    from distributed_groth16_tpu.models.groth16.proving_key import (
+        _pack_query_scalars,
+    )
+    from distributed_groth16_tpu.ops.field import fr
+
+    scal = fr().encode(list(range(2, m + 2)))
+    t0 = time.time()
+    outs = _pack_query_scalars("g1", pp, scal)
+    np.asarray(outs)
+    scalar_cold = time.time() - t0
+    t0 = time.time()
+    outs = _pack_query_scalars("g1", pp, scal)
+    np.asarray(outs)
+    scalar_warm = time.time() - t0
+
     print(
         json.dumps(
             {
@@ -70,6 +89,9 @@ def main() -> int:
                 "warm_s": round(warm, 2),
                 "cold_s": round(cold, 2),
                 "points_per_sec": round(m / warm, 1),
+                "scalar_route_warm_s": round(scalar_warm, 2),
+                "scalar_route_cold_s": round(scalar_cold, 2),
+                "scalar_route_points_per_sec": round(m / scalar_warm, 1),
             }
         ),
         flush=True,

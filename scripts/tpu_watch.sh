@@ -1,0 +1,36 @@
+#!/bin/bash
+# Tunnel watchdog: probe the axon TPU backend on a loop; the moment a
+# probe succeeds, fire scripts/tpu_session.sh (the one-shot measurement
+# program) and exit. Probes run in a subprocess with a hard timeout
+# because a half-open tunnel HANGS make_c_api_client rather than failing
+# (observed round 5: >120 s wedge under JAX_PLATFORMS=cpu even).
+#
+# Usage: scripts/tpu_watch.sh [logdir] [probe_timeout_s] [interval_s]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/tpu_watch}
+PROBE_T=${2:-420}
+INTERVAL=${3:-480}
+mkdir -p "$LOG"
+stamp() { date -u +%H:%M:%S; }
+note() { echo "$(stamp) $*" | tee -a "$LOG/watch.log"; }
+
+note "=== tpu_watch start (probe_timeout=${PROBE_T}s interval=${INTERVAL}s)"
+i=0
+while true; do
+  i=$((i + 1))
+  t0=$(date +%s)
+  out=$(timeout "$PROBE_T" python -c \
+    "import jax; d=jax.devices()[0]; print(d.platform)" 2>&1 | tail -1; \
+    exit "${PIPESTATUS[0]}")
+  rc=$?
+  dt=$(( $(date +%s) - t0 ))
+  note "probe #$i rc=$rc dt=${dt}s out=${out}"
+  if [ "$rc" -eq 0 ] && { [ "$out" = "tpu" ] || [ "$out" = "axon" ]; }; then
+    note "tunnel UP — firing tpu_session.sh"
+    bash scripts/tpu_session.sh "$LOG/session"
+    note "session complete; exiting watchdog"
+    exit 0
+  fi
+  sleep "$INTERVAL"
+done

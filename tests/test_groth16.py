@@ -148,3 +148,26 @@ def test_zk_proof_r_s_nonzero_verifies(world):
     assert verify(pk.vk, proof, publics), "randomized proof failed pairing"
     det = prove_host(pk, r1cs, z)
     assert proof.a != det.a, "r != 0 must randomize A"
+
+
+def test_scalar_route_pack_matches_point_route(world):
+    # pack_proving_key's scalar route (field-NTT pack + fixed-base) must
+    # produce the SAME GROUP ELEMENTS as the in-exponent point route —
+    # projective representatives may differ, so compare affine decodes.
+    from dataclasses import replace
+
+    from distributed_groth16_tpu.ops.curve import g1, g2
+
+    pk = world["pk"]
+    pp = world["pp"]
+    assert pk.query_scalars is not None  # in-process setup keeps scalars
+    fast = pack_proving_key(pk, pp)
+    slow = pack_proving_key(replace(pk, query_scalars=None), pp)
+    C1, C2 = g1(), g2()
+    for f, s in zip(fast, slow):
+        for name, curve in (
+            ("s", C1), ("u", C1), ("w", C1), ("h", C1), ("v", C2)
+        ):
+            a = curve.decode(getattr(f, name))
+            b = curve.decode(getattr(s, name))
+            assert list(a) == list(b), f"query {name} diverged"
