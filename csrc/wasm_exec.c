@@ -43,6 +43,31 @@ enum {
     WX_TRAP_OOB = 9, /* out-of-bounds linear-memory access */
 };
 
+#if defined(__GNUC__) && !defined(WX_NO_THREADING)
+#define WX_THREADED 1
+#else
+#define WX_THREADED 0
+#endif
+
+#if WX_THREADED
+/* labels-as-values dispatch: NEXT() loads the next instruction and jumps
+ * straight to its handler (bounds-checked: a hostile pre-decoded stream
+ * could carry an op outside the byte range). */
+#define OP(x) L_##x:
+#define OP_DEFAULT L_BAD:
+#define NEXT()                                                               \
+    do {                                                                     \
+        if (pc >= ncode) goto func_return;                                   \
+        I = &code[pc];                                                       \
+        pc++;                                                                \
+        goto *((uint64_t)I->op < 256 ? optable[I->op] : &&L_BAD);            \
+    } while (0)
+#else
+#define OP(x) case x:
+#define OP_DEFAULT default:
+#define NEXT() break
+#endif
+
 typedef struct {
     int64_t op, a, b, c;
 } Ins;
@@ -167,52 +192,67 @@ static void exec_func(Engine *E, int64_t lf, int64_t base) {
     int64_t nf = 0;
     int64_t pc = 0;
 
+    const Ins *I;
+#if WX_THREADED
+    /* token-threaded dispatch (GCC labels-as-values):each opcode body ends
+     * with its own indirect jump, so the branch predictor learns
+     * per-predecessor opcode patterns — the interpreter-dispatch win the
+     * reference gets from wasmer's JIT compilation
+     * (ark-circom/src/witness/witness_calculator.rs:56-153) approximated
+     * without emitting native code. The switch build below remains the
+     * portable fallback (-DWX_NO_THREADING or non-GCC). */
+    static const void *optable[256] = {
+        [0 ... 255] = &&L_BAD,
+        [0x20] = &&L_0x20, [0x41] = &&L_0x41, [0x42] = &&L_0x42, [0x21] = &&L_0x21, [0x22] = &&L_0x22, [0x28] = &&L_0x28, [0x36] = &&L_0x36, [0x29] = &&L_0x29, [0x37] = &&L_0x37, [0x6A] = &&L_0x6A, [0x7C] = &&L_0x7C, [0x02] = &&L_0x02, [0x03] = &&L_0x03, [0x04] = &&L_0x04, [0x05] = &&L_0x05, [0x0B] = &&L_0x0B, [0x0C] = &&L_0x0C, [0x0D] = &&L_0x0D, [0x0E] = &&L_0x0E, [0x0F] = &&L_0x0F, [0x10] = &&L_0x10, [0x11] = &&L_0x11, [0x1A] = &&L_0x1A, [0x1B] = &&L_0x1B, [0x23] = &&L_0x23, [0x24] = &&L_0x24, [0x2C] = &&L_0x2C, [0x2D] = &&L_0x2D, [0x2E] = &&L_0x2E, [0x2F] = &&L_0x2F, [0x30] = &&L_0x30, [0x31] = &&L_0x31, [0x32] = &&L_0x32, [0x33] = &&L_0x33, [0x34] = &&L_0x34, [0x35] = &&L_0x35, [0x3A] = &&L_0x3A, [0x3B] = &&L_0x3B, [0x3C] = &&L_0x3C, [0x3D] = &&L_0x3D, [0x3E] = &&L_0x3E, [0x3F] = &&L_0x3F, [0x40] = &&L_0x40, [0x45] = &&L_0x45, [0x46] = &&L_0x46, [0x47] = &&L_0x47, [0x48] = &&L_0x48, [0x49] = &&L_0x49, [0x4A] = &&L_0x4A, [0x4B] = &&L_0x4B, [0x4C] = &&L_0x4C, [0x4D] = &&L_0x4D, [0x4E] = &&L_0x4E, [0x4F] = &&L_0x4F, [0x50] = &&L_0x50, [0x51] = &&L_0x51, [0x52] = &&L_0x52, [0x53] = &&L_0x53, [0x54] = &&L_0x54, [0x55] = &&L_0x55, [0x56] = &&L_0x56, [0x57] = &&L_0x57, [0x58] = &&L_0x58, [0x59] = &&L_0x59, [0x5A] = &&L_0x5A, [0x67] = &&L_0x67, [0x68] = &&L_0x68, [0x69] = &&L_0x69, [0x6B] = &&L_0x6B, [0x6C] = &&L_0x6C, [0x6D] = &&L_0x6D, [0x6E] = &&L_0x6E, [0x6F] = &&L_0x6F, [0x70] = &&L_0x70, [0x71] = &&L_0x71, [0x72] = &&L_0x72, [0x73] = &&L_0x73, [0x74] = &&L_0x74, [0x75] = &&L_0x75, [0x76] = &&L_0x76, [0x77] = &&L_0x77, [0x78] = &&L_0x78, [0x79] = &&L_0x79, [0x7A] = &&L_0x7A, [0x7B] = &&L_0x7B, [0x7D] = &&L_0x7D, [0x7E] = &&L_0x7E, [0x7F] = &&L_0x7F, [0x80] = &&L_0x80, [0x81] = &&L_0x81, [0x82] = &&L_0x82, [0x83] = &&L_0x83, [0x84] = &&L_0x84, [0x85] = &&L_0x85, [0x86] = &&L_0x86, [0x87] = &&L_0x87, [0x88] = &&L_0x88, [0xA7] = &&L_0xA7, [0xAC] = &&L_0xAC, [0xAD] = &&L_0xAD, [0x00] = &&L_0x00, [0x01] = &&L_0x01
+    };
+    NEXT();
+#else
     while (pc < ncode) {
-        const Ins *I = &code[pc];
-        const int64_t op = I->op;
+        I = &code[pc];
         pc++;
-        switch (op) {
-        case 0x20: st[sp++] = loc[I->a]; break;            /* local.get */
-        case 0x41: case 0x42: st[sp++] = (uint64_t)I->a; break; /* const */
-        case 0x21: loc[I->a] = st[--sp]; break;            /* local.set */
-        case 0x22: loc[I->a] = st[sp - 1]; break;          /* local.tee */
-        case 0x28: { uint32_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 4), 4);
-                     st[sp-1] = v; break; }                /* i32.load */
-        case 0x36: { uint64_t v = st[--sp]; uint32_t w = (uint32_t)v;
-                     memcpy(MEMADDR(E, st[--sp] + I->a, 4), &w, 4); break; }
-        case 0x29: { uint64_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 8), 8);
-                     st[sp-1] = v; break; }                /* i64.load */
-        case 0x37: { uint64_t v = st[--sp];
-                     memcpy(MEMADDR(E, st[--sp] + I->a, 8), &v, 8); break; }
-        case 0x6A: { uint64_t v = st[--sp];
-                     st[sp-1] = (st[sp-1] + v) & M32; break; } /* i32.add */
-        case 0x7C: { uint64_t v = st[--sp];
-                     st[sp-1] = st[sp-1] + v; break; }     /* i64.add */
-        case 0x02: /* block */
+        switch (I->op) {
+#endif
+        OP(0x20) st[sp++] = loc[I->a]; NEXT();            /* local.get */
+        OP(0x41) OP(0x42) st[sp++] = (uint64_t)I->a; NEXT(); /* const */
+        OP(0x21) loc[I->a] = st[--sp]; NEXT();            /* local.set */
+        OP(0x22) loc[I->a] = st[sp - 1]; NEXT();          /* local.tee */
+        OP(0x28) { uint32_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 4), 4);
+                     st[sp-1] = v; NEXT(); }                /* i32.load */
+        OP(0x36) { uint64_t v = st[--sp]; uint32_t w = (uint32_t)v;
+                     memcpy(MEMADDR(E, st[--sp] + I->a, 4), &w, 4); NEXT(); }
+        OP(0x29) { uint64_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 8), 8);
+                     st[sp-1] = v; NEXT(); }                /* i64.load */
+        OP(0x37) { uint64_t v = st[--sp];
+                     memcpy(MEMADDR(E, st[--sp] + I->a, 8), &v, 8); NEXT(); }
+        OP(0x6A) { uint64_t v = st[--sp];
+                     st[sp-1] = (st[sp-1] + v) & M32; NEXT(); } /* i32.add */
+        OP(0x7C) { uint64_t v = st[--sp];
+                     st[sp-1] = st[sp-1] + v; NEXT(); }     /* i64.add */
+        OP(0x02) /* block */
             if (fb + nf >= FRAME_POOL_CAP) trap(E, WX_TRAP_STACK);
             frames[nf++] = (Frame){0, I->b + 1, sp, I->a};
-            break;
-        case 0x03: /* loop */
+            NEXT();
+        OP(0x03) /* loop */
             if (fb + nf >= FRAME_POOL_CAP) trap(E, WX_TRAP_STACK);
             frames[nf++] = (Frame){1, pc, sp, 0};
-            break;
-        case 0x04: { /* if: a=arity, b=end_pc, c=else_pc */
+            NEXT();
+        OP(0x04) { /* if: a=arity, b=end_pc, c=else_pc */
             uint64_t cond = st[--sp];
             if (fb + nf >= FRAME_POOL_CAP) trap(E, WX_TRAP_STACK);
             frames[nf++] = (Frame){0, I->b + 1, sp, I->a};
             if (!cond) pc = (I->c != -1) ? I->c : I->b;
-            break; }
-        case 0x05: pc = I->b; break; /* else marker: jump to end instr */
-        case 0x0B: /* end */
+            NEXT(); }
+        OP(0x05) pc = I->b; NEXT(); /* else marker: jump to end instr */
+        OP(0x0B) /* end */
             if (I->a == -1) goto func_return;
             nf--;
-            break;
-        case 0x0C: case 0x0D: case 0x0E: { /* br / br_if / br_table */
+            NEXT();
+        OP(0x0C) OP(0x0D) OP(0x0E) { /* br / br_if / br_table */
             int64_t depth;
-            if (op == 0x0D) {
-                if (!st[--sp]) break;
+            if (I->op == 0x0D) {
+                if (!st[--sp]) NEXT();
                 depth = I->a;
-            } else if (op == 0x0E) {
+            } else if (I->op == 0x0E) {
                 uint64_t k = st[--sp];
                 depth = (k < (uint64_t)I->b) ? E->br_pool[I->a + k] : I->c;
             } else {
@@ -221,7 +261,7 @@ static void exec_func(Engine *E, int64_t lf, int64_t base) {
             if (depth >= nf) { nf = 0; goto func_return; }
             nf -= depth;
             Frame *F = &frames[nf - 1];
-            if (F->is_loop) { sp = F->height; pc = F->target; break; }
+            if (F->is_loop) { sp = F->height; pc = F->target; NEXT(); }
             {   int64_t ar = F->arity;
                 if (ar) memmove(st + F->height, st + sp - ar,
                                 (size_t)ar * sizeof(uint64_t));
@@ -229,166 +269,169 @@ static void exec_func(Engine *E, int64_t lf, int64_t base) {
                 nf--;
                 pc = F->target;
             }
-            break; }
-        case 0x0F: goto func_return; /* return */
-        case 0x10: /* call */
+            NEXT(); }
+        OP(0x0F) goto func_return; /* return */
+        OP(0x10) /* call */
             E->frame_base = fb + nf;
             sp = do_call(E, I->a, sp);
             E->frame_base = fb;
-            break;
-        case 0x11: { /* call_indirect: a = type idx */
+            NEXT();
+        OP(0x11) { /* call_indirect: a = type idx */
             uint64_t k = st[--sp];
             if (k >= (uint64_t)E->ntable || E->table[k] < 0)
                 trap(E, WX_TRAP_BAD_TABLE);
             E->frame_base = fb + nf;
             sp = do_call(E, E->table[k], sp);
             E->frame_base = fb;
-            break; }
-        case 0x1A: sp--; break; /* drop */
-        case 0x1B: { uint64_t c = st[--sp], b2 = st[--sp];
-                     if (!c) st[sp-1] = b2; break; } /* select */
-        case 0x23: st[sp++] = (uint64_t)E->globals[I->a]; break;
-        case 0x24: E->globals[I->a] = (int64_t)st[--sp]; break;
-        case 0x2C: { uint8_t v = *MEMADDR(E, st[sp-1] + I->a, 1);
-                     st[sp-1] = (uint64_t)((int8_t)v) & M32; break; }
-        case 0x2D: st[sp-1] = *MEMADDR(E, st[sp-1] + I->a, 1); break;
-        case 0x2E: { uint16_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 2), 2);
-                     st[sp-1] = (uint64_t)((int16_t)v) & M32; break; }
-        case 0x2F: { uint16_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 2), 2);
-                     st[sp-1] = v; break; }
-        case 0x30: { uint8_t v = *MEMADDR(E, st[sp-1] + I->a, 1);
-                     st[sp-1] = (uint64_t)(int64_t)(int8_t)v; break; }
-        case 0x31: st[sp-1] = *MEMADDR(E, st[sp-1] + I->a, 1); break;
-        case 0x32: { uint16_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 2), 2);
-                     st[sp-1] = (uint64_t)(int64_t)(int16_t)v; break; }
-        case 0x33: { uint16_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 2), 2);
-                     st[sp-1] = v; break; }
-        case 0x34: { uint32_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 4), 4);
-                     st[sp-1] = (uint64_t)(int64_t)(int32_t)v; break; }
-        case 0x35: { uint32_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 4), 4);
-                     st[sp-1] = v; break; }
-        case 0x3A: { uint64_t v = st[--sp];
-                     *MEMADDR(E, st[--sp] + I->a, 1) = (uint8_t)v; break; }
-        case 0x3B: { uint64_t v = st[--sp]; uint16_t w = (uint16_t)v;
-                     memcpy(MEMADDR(E, st[--sp] + I->a, 2), &w, 2); break; }
-        case 0x3C: { uint64_t v = st[--sp];
-                     *MEMADDR(E, st[--sp] + I->a, 1) = (uint8_t)v; break; }
-        case 0x3D: { uint64_t v = st[--sp]; uint16_t w = (uint16_t)v;
-                     memcpy(MEMADDR(E, st[--sp] + I->a, 2), &w, 2); break; }
-        case 0x3E: { uint64_t v = st[--sp]; uint32_t w = (uint32_t)v;
-                     memcpy(MEMADDR(E, st[--sp] + I->a, 4), &w, 4); break; }
-        case 0x3F: st[sp++] = (uint64_t)*E->cur_pages; break;
-        case 0x40: { /* memory.grow (buffer pre-sized to max_pages) */
+            NEXT(); }
+        OP(0x1A) sp--; NEXT(); /* drop */
+        OP(0x1B) { uint64_t c = st[--sp], b2 = st[--sp];
+                     if (!c) { st[sp-1] = b2; }
+                     NEXT(); } /* select */
+        OP(0x23) st[sp++] = (uint64_t)E->globals[I->a]; NEXT();
+        OP(0x24) E->globals[I->a] = (int64_t)st[--sp]; NEXT();
+        OP(0x2C) { uint8_t v = *MEMADDR(E, st[sp-1] + I->a, 1);
+                     st[sp-1] = (uint64_t)((int8_t)v) & M32; NEXT(); }
+        OP(0x2D) st[sp-1] = *MEMADDR(E, st[sp-1] + I->a, 1); NEXT();
+        OP(0x2E) { uint16_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 2), 2);
+                     st[sp-1] = (uint64_t)((int16_t)v) & M32; NEXT(); }
+        OP(0x2F) { uint16_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 2), 2);
+                     st[sp-1] = v; NEXT(); }
+        OP(0x30) { uint8_t v = *MEMADDR(E, st[sp-1] + I->a, 1);
+                     st[sp-1] = (uint64_t)(int64_t)(int8_t)v; NEXT(); }
+        OP(0x31) st[sp-1] = *MEMADDR(E, st[sp-1] + I->a, 1); NEXT();
+        OP(0x32) { uint16_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 2), 2);
+                     st[sp-1] = (uint64_t)(int64_t)(int16_t)v; NEXT(); }
+        OP(0x33) { uint16_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 2), 2);
+                     st[sp-1] = v; NEXT(); }
+        OP(0x34) { uint32_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 4), 4);
+                     st[sp-1] = (uint64_t)(int64_t)(int32_t)v; NEXT(); }
+        OP(0x35) { uint32_t v; memcpy(&v, MEMADDR(E, st[sp-1] + I->a, 4), 4);
+                     st[sp-1] = v; NEXT(); }
+        OP(0x3A) { uint64_t v = st[--sp];
+                     *MEMADDR(E, st[--sp] + I->a, 1) = (uint8_t)v; NEXT(); }
+        OP(0x3B) { uint64_t v = st[--sp]; uint16_t w = (uint16_t)v;
+                     memcpy(MEMADDR(E, st[--sp] + I->a, 2), &w, 2); NEXT(); }
+        OP(0x3C) { uint64_t v = st[--sp];
+                     *MEMADDR(E, st[--sp] + I->a, 1) = (uint8_t)v; NEXT(); }
+        OP(0x3D) { uint64_t v = st[--sp]; uint16_t w = (uint16_t)v;
+                     memcpy(MEMADDR(E, st[--sp] + I->a, 2), &w, 2); NEXT(); }
+        OP(0x3E) { uint64_t v = st[--sp]; uint32_t w = (uint32_t)v;
+                     memcpy(MEMADDR(E, st[--sp] + I->a, 4), &w, 4); NEXT(); }
+        OP(0x3F) st[sp++] = (uint64_t)*E->cur_pages; NEXT();
+        OP(0x40) { /* memory.grow (buffer pre-sized to max_pages) */
             uint64_t delta = st[--sp];
             int64_t old = *E->cur_pages;
             if (old + (int64_t)delta > E->max_pages) trap(E, WX_TRAP_OOM);
             *E->cur_pages = old + (int64_t)delta;
             st[sp++] = (uint64_t)old;
-            break; }
-        case 0x45: st[sp-1] = (st[sp-1] == 0); break; /* i32.eqz */
-        case 0x46: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] == v); break; }
-        case 0x47: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] != v); break; }
-        case 0x48: { int64_t v = s32(st[--sp]);
-                     st[sp-1] = (s32(st[sp-1]) < v); break; }
-        case 0x49: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] < v); break; }
-        case 0x4A: { int64_t v = s32(st[--sp]);
-                     st[sp-1] = (s32(st[sp-1]) > v); break; }
-        case 0x4B: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] > v); break; }
-        case 0x4C: { int64_t v = s32(st[--sp]);
-                     st[sp-1] = (s32(st[sp-1]) <= v); break; }
-        case 0x4D: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] <= v); break; }
-        case 0x4E: { int64_t v = s32(st[--sp]);
-                     st[sp-1] = (s32(st[sp-1]) >= v); break; }
-        case 0x4F: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] >= v); break; }
-        case 0x50: st[sp-1] = (st[sp-1] == 0); break; /* i64.eqz */
-        case 0x51: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] == v); break; }
-        case 0x52: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] != v); break; }
-        case 0x53: { int64_t v = s64(st[--sp]);
-                     st[sp-1] = (s64(st[sp-1]) < v); break; }
-        case 0x54: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] < v); break; }
-        case 0x55: { int64_t v = s64(st[--sp]);
-                     st[sp-1] = (s64(st[sp-1]) > v); break; }
-        case 0x56: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] > v); break; }
-        case 0x57: { int64_t v = s64(st[--sp]);
-                     st[sp-1] = (s64(st[sp-1]) <= v); break; }
-        case 0x58: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] <= v); break; }
-        case 0x59: { int64_t v = s64(st[--sp]);
-                     st[sp-1] = (s64(st[sp-1]) >= v); break; }
-        case 0x5A: { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] >= v); break; }
-        case 0x67: { uint32_t v = (uint32_t)st[sp-1];
-                     st[sp-1] = v ? (uint64_t)__builtin_clz(v) : 32; break; }
-        case 0x68: { uint32_t v = (uint32_t)st[sp-1];
-                     st[sp-1] = v ? (uint64_t)__builtin_ctz(v) : 32; break; }
-        case 0x69: st[sp-1] = (uint64_t)__builtin_popcountll(st[sp-1] & M32);
-                   break;
-        case 0x6B: { uint64_t v = st[--sp];
-                     st[sp-1] = (st[sp-1] - v) & M32; break; }
-        case 0x6C: { uint64_t v = st[--sp];
-                     st[sp-1] = (st[sp-1] * v) & M32; break; }
-        case 0x6D: { int64_t v = s32(st[--sp]); int64_t a = s32(st[sp-1]);
+            NEXT(); }
+        OP(0x45) st[sp-1] = (st[sp-1] == 0); NEXT(); /* i32.eqz */
+        OP(0x46) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] == v); NEXT(); }
+        OP(0x47) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] != v); NEXT(); }
+        OP(0x48) { int64_t v = s32(st[--sp]);
+                     st[sp-1] = (s32(st[sp-1]) < v); NEXT(); }
+        OP(0x49) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] < v); NEXT(); }
+        OP(0x4A) { int64_t v = s32(st[--sp]);
+                     st[sp-1] = (s32(st[sp-1]) > v); NEXT(); }
+        OP(0x4B) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] > v); NEXT(); }
+        OP(0x4C) { int64_t v = s32(st[--sp]);
+                     st[sp-1] = (s32(st[sp-1]) <= v); NEXT(); }
+        OP(0x4D) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] <= v); NEXT(); }
+        OP(0x4E) { int64_t v = s32(st[--sp]);
+                     st[sp-1] = (s32(st[sp-1]) >= v); NEXT(); }
+        OP(0x4F) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] >= v); NEXT(); }
+        OP(0x50) st[sp-1] = (st[sp-1] == 0); NEXT(); /* i64.eqz */
+        OP(0x51) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] == v); NEXT(); }
+        OP(0x52) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] != v); NEXT(); }
+        OP(0x53) { int64_t v = s64(st[--sp]);
+                     st[sp-1] = (s64(st[sp-1]) < v); NEXT(); }
+        OP(0x54) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] < v); NEXT(); }
+        OP(0x55) { int64_t v = s64(st[--sp]);
+                     st[sp-1] = (s64(st[sp-1]) > v); NEXT(); }
+        OP(0x56) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] > v); NEXT(); }
+        OP(0x57) { int64_t v = s64(st[--sp]);
+                     st[sp-1] = (s64(st[sp-1]) <= v); NEXT(); }
+        OP(0x58) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] <= v); NEXT(); }
+        OP(0x59) { int64_t v = s64(st[--sp]);
+                     st[sp-1] = (s64(st[sp-1]) >= v); NEXT(); }
+        OP(0x5A) { uint64_t v = st[--sp]; st[sp-1] = (st[sp-1] >= v); NEXT(); }
+        OP(0x67) { uint32_t v = (uint32_t)st[sp-1];
+                     st[sp-1] = v ? (uint64_t)__builtin_clz(v) : 32; NEXT(); }
+        OP(0x68) { uint32_t v = (uint32_t)st[sp-1];
+                     st[sp-1] = v ? (uint64_t)__builtin_ctz(v) : 32; NEXT(); }
+        OP(0x69) st[sp-1] = (uint64_t)__builtin_popcountll(st[sp-1] & M32);
+                   NEXT();
+        OP(0x6B) { uint64_t v = st[--sp];
+                     st[sp-1] = (st[sp-1] - v) & M32; NEXT(); }
+        OP(0x6C) { uint64_t v = st[--sp];
+                     st[sp-1] = (st[sp-1] * v) & M32; NEXT(); }
+        OP(0x6D) { int64_t v = s32(st[--sp]); int64_t a = s32(st[sp-1]);
                      if (!v) trap(E, WX_TRAP_DIV_ZERO);
                      if (a == INT32_MIN && v == -1) trap(E, WX_TRAP_OVERFLOW);
-                     st[sp-1] = (uint64_t)(a / v) & M32; break; }
-        case 0x6E: { uint64_t v = st[--sp] & M32;
+                     st[sp-1] = (uint64_t)(a / v) & M32; NEXT(); }
+        OP(0x6E) { uint64_t v = st[--sp] & M32;
                      if (!v) trap(E, WX_TRAP_DIV_ZERO);
-                     st[sp-1] = (st[sp-1] & M32) / v; break; }
-        case 0x6F: { int64_t v = s32(st[--sp]); int64_t a = s32(st[sp-1]);
+                     st[sp-1] = (st[sp-1] & M32) / v; NEXT(); }
+        OP(0x6F) { int64_t v = s32(st[--sp]); int64_t a = s32(st[sp-1]);
                      if (!v) trap(E, WX_TRAP_DIV_ZERO);
-                     st[sp-1] = (uint64_t)(a % v) & M32; break; }
-        case 0x70: { uint64_t v = st[--sp] & M32;
+                     st[sp-1] = (uint64_t)(a % v) & M32; NEXT(); }
+        OP(0x70) { uint64_t v = st[--sp] & M32;
                      if (!v) trap(E, WX_TRAP_DIV_ZERO);
-                     st[sp-1] = (st[sp-1] & M32) % v; break; }
-        case 0x71: { uint64_t v = st[--sp]; st[sp-1] &= v; break; }
-        case 0x72: { uint64_t v = st[--sp]; st[sp-1] |= v; break; }
-        case 0x73: { uint64_t v = st[--sp]; st[sp-1] ^= v; break; }
-        case 0x74: { uint64_t v = st[--sp] & 31;
-                     st[sp-1] = (st[sp-1] << v) & M32; break; }
-        case 0x75: { uint64_t v = st[--sp] & 31;
-                     st[sp-1] = (uint64_t)(s32(st[sp-1]) >> v) & M32; break; }
-        case 0x76: { uint64_t v = st[--sp] & 31;
-                     st[sp-1] = (st[sp-1] & M32) >> v; break; }
-        case 0x77: { uint64_t v = st[--sp] & 31; uint32_t a = (uint32_t)st[sp-1];
-                     st[sp-1] = v ? ((a << v) | (a >> (32 - v))) : a; break; }
-        case 0x78: { uint64_t v = st[--sp] & 31; uint32_t a = (uint32_t)st[sp-1];
-                     st[sp-1] = v ? ((a >> v) | (a << (32 - v))) : a; break; }
-        case 0x79: st[sp-1] = st[sp-1] ? (uint64_t)__builtin_clzll(st[sp-1])
-                                       : 64; break;
-        case 0x7A: st[sp-1] = st[sp-1] ? (uint64_t)__builtin_ctzll(st[sp-1])
-                                       : 64; break;
-        case 0x7B: st[sp-1] = (uint64_t)__builtin_popcountll(st[sp-1]); break;
-        case 0x7D: { uint64_t v = st[--sp]; st[sp-1] -= v; break; }
-        case 0x7E: { uint64_t v = st[--sp]; st[sp-1] *= v; break; }
-        case 0x7F: { int64_t v = s64(st[--sp]); int64_t a = s64(st[sp-1]);
+                     st[sp-1] = (st[sp-1] & M32) % v; NEXT(); }
+        OP(0x71) { uint64_t v = st[--sp]; st[sp-1] &= v; NEXT(); }
+        OP(0x72) { uint64_t v = st[--sp]; st[sp-1] |= v; NEXT(); }
+        OP(0x73) { uint64_t v = st[--sp]; st[sp-1] ^= v; NEXT(); }
+        OP(0x74) { uint64_t v = st[--sp] & 31;
+                     st[sp-1] = (st[sp-1] << v) & M32; NEXT(); }
+        OP(0x75) { uint64_t v = st[--sp] & 31;
+                     st[sp-1] = (uint64_t)(s32(st[sp-1]) >> v) & M32; NEXT(); }
+        OP(0x76) { uint64_t v = st[--sp] & 31;
+                     st[sp-1] = (st[sp-1] & M32) >> v; NEXT(); }
+        OP(0x77) { uint64_t v = st[--sp] & 31; uint32_t a = (uint32_t)st[sp-1];
+                     st[sp-1] = v ? ((a << v) | (a >> (32 - v))) : a; NEXT(); }
+        OP(0x78) { uint64_t v = st[--sp] & 31; uint32_t a = (uint32_t)st[sp-1];
+                     st[sp-1] = v ? ((a >> v) | (a << (32 - v))) : a; NEXT(); }
+        OP(0x79) st[sp-1] = st[sp-1] ? (uint64_t)__builtin_clzll(st[sp-1])
+                                       : 64; NEXT();
+        OP(0x7A) st[sp-1] = st[sp-1] ? (uint64_t)__builtin_ctzll(st[sp-1])
+                                       : 64; NEXT();
+        OP(0x7B) st[sp-1] = (uint64_t)__builtin_popcountll(st[sp-1]); NEXT();
+        OP(0x7D) { uint64_t v = st[--sp]; st[sp-1] -= v; NEXT(); }
+        OP(0x7E) { uint64_t v = st[--sp]; st[sp-1] *= v; NEXT(); }
+        OP(0x7F) { int64_t v = s64(st[--sp]); int64_t a = s64(st[sp-1]);
                      if (!v) trap(E, WX_TRAP_DIV_ZERO);
                      if (a == INT64_MIN && v == -1) trap(E, WX_TRAP_OVERFLOW);
-                     st[sp-1] = (uint64_t)(a / v); break; }
-        case 0x80: { uint64_t v = st[--sp];
+                     st[sp-1] = (uint64_t)(a / v); NEXT(); }
+        OP(0x80) { uint64_t v = st[--sp];
                      if (!v) trap(E, WX_TRAP_DIV_ZERO);
-                     st[sp-1] /= v; break; }
-        case 0x81: { int64_t v = s64(st[--sp]); int64_t a = s64(st[sp-1]);
+                     st[sp-1] /= v; NEXT(); }
+        OP(0x81) { int64_t v = s64(st[--sp]); int64_t a = s64(st[sp-1]);
                      if (!v) trap(E, WX_TRAP_DIV_ZERO);
                      /* INT64_MIN % -1 is UB in C (SIGFPE); wasm says 0 */
                      st[sp-1] = (a == INT64_MIN && v == -1)
                                     ? 0 : (uint64_t)(a % v);
-                     break; }
-        case 0x82: { uint64_t v = st[--sp];
+                     NEXT(); }
+        OP(0x82) { uint64_t v = st[--sp];
                      if (!v) trap(E, WX_TRAP_DIV_ZERO);
-                     st[sp-1] %= v; break; }
-        case 0x83: { uint64_t v = st[--sp]; st[sp-1] &= v; break; }
-        case 0x84: { uint64_t v = st[--sp]; st[sp-1] |= v; break; }
-        case 0x85: { uint64_t v = st[--sp]; st[sp-1] ^= v; break; }
-        case 0x86: { uint64_t v = st[--sp] & 63; st[sp-1] <<= v; break; }
-        case 0x87: { uint64_t v = st[--sp] & 63;
-                     st[sp-1] = (uint64_t)(s64(st[sp-1]) >> v); break; }
-        case 0x88: { uint64_t v = st[--sp] & 63; st[sp-1] >>= v; break; }
-        case 0xA7: st[sp-1] &= M32; break;        /* i32.wrap_i64 */
-        case 0xAC: st[sp-1] = (uint64_t)(int64_t)s32(st[sp-1]); break;
-        case 0xAD: break;                         /* i64.extend_i32_u */
-        case 0x00: trap(E, WX_TRAP_UNREACHABLE);
-        case 0x01: break;                         /* nop */
-        default: trap(E, WX_TRAP_BAD_OP);
+                     st[sp-1] %= v; NEXT(); }
+        OP(0x83) { uint64_t v = st[--sp]; st[sp-1] &= v; NEXT(); }
+        OP(0x84) { uint64_t v = st[--sp]; st[sp-1] |= v; NEXT(); }
+        OP(0x85) { uint64_t v = st[--sp]; st[sp-1] ^= v; NEXT(); }
+        OP(0x86) { uint64_t v = st[--sp] & 63; st[sp-1] <<= v; NEXT(); }
+        OP(0x87) { uint64_t v = st[--sp] & 63;
+                     st[sp-1] = (uint64_t)(s64(st[sp-1]) >> v); NEXT(); }
+        OP(0x88) { uint64_t v = st[--sp] & 63; st[sp-1] >>= v; NEXT(); }
+        OP(0xA7) st[sp-1] &= M32; NEXT();        /* i32.wrap_i64 */
+        OP(0xAC) st[sp-1] = (uint64_t)(int64_t)s32(st[sp-1]); NEXT();
+        OP(0xAD) NEXT();                         /* i64.extend_i32_u */
+        OP(0x00) trap(E, WX_TRAP_UNREACHABLE);
+        OP(0x01) NEXT();                         /* nop */
+        OP_DEFAULT trap(E, WX_TRAP_BAD_OP);
+#if !WX_THREADED
         }
     }
+#endif
 func_return:
     /* move the top nres values down to base (results of the function) */
     if (nres)

@@ -110,14 +110,24 @@ def _rot(a):
 
 
 class LimbField:
-    """Montgomery arithmetic on limb-major uint32[16, n] in [0, 2p)."""
+    """Montgomery arithmetic on limb-major uint32[nl, n] in [0, 2p).
 
-    def __init__(self, modulus: int):
+    nl defaults to 16 rows (BN254-class, radix 2^256); larger moduli pass
+    their limb count (24 for BLS12-377/381 Fq, radix 2^384) and every
+    body below derives its row count from self.nl / the input shape —
+    same ops, same roll modes, wider tiles."""
+
+    def __init__(self, modulus: int, nl: int = NL):
+        assert 4 * modulus < 1 << (LIMB_BITS * nl), "lazy-carry redundancy"
         self.p = modulus
+        self.nl = nl
+        self.CR = nl  # coordinate rows: one Fq element = nl limb rows
         self.n0 = int((-pow(modulus, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS))
-        self.p_col = np.array(to_limbs(modulus), np.uint32).reshape(NL, 1)
-        self.p2_col = np.array(to_limbs(2 * modulus), np.uint32).reshape(NL, 1)
-        self.mont_r = (1 << 256) % modulus
+        self.p_col = np.array(to_limbs(modulus, nl), np.uint32).reshape(nl, 1)
+        self.p2_col = np.array(
+            to_limbs(2 * modulus, nl), np.uint32
+        ).reshape(nl, 1)
+        self.mont_r = (1 << (LIMB_BITS * nl)) % modulus
 
     # consts are passed in explicitly so the same bodies work inside Pallas
     # kernels (which reject captured device constants).
@@ -132,15 +142,16 @@ class LimbField:
     # Pallas compile-friendly middle ground (~10x smaller bodies).
 
     def carry(self, v, unroll=True):
-        """(k, n) lazy rows -> (16, n) carried limbs (value < 2^256).
+        """(k, n) lazy rows -> (nl, n) carried limbs (value < radix).
 
-        Rows beyond 16 (the CIOS accumulator's top row, zero by the shift
+        Rows beyond nl (the CIOS accumulator's top row, zero by the shift
         invariant) are dropped.
         """
-        v = v[:NL]
+        nl = self.nl
+        v = v[:nl]
         if unroll == "fori":
             # out self-assembles by appending each carried row at the
-            # bottom: after 16 iterations rows sit in order 0..15.
+            # bottom: after nl iterations rows sit in order 0..nl-1.
             def body(i, st):
                 out, c, vr = st
                 t = vr[0:1] + c
@@ -151,7 +162,7 @@ class LimbField:
                 )
 
             out, _, _ = jax.lax.fori_loop(
-                0, NL, body,
+                0, nl, body,
                 (jnp.zeros_like(v), jnp.zeros_like(v[0:1]), v),
             )
             return out
@@ -163,7 +174,7 @@ class LimbField:
             _, out = jax.lax.scan(step, jnp.zeros_like(v[0]), v)
             return out
         rows, c = [], jnp.zeros_like(v[0:1])
-        for i in range(NL):
+        for i in range(nl):
             t = v[i : i + 1] + c
             rows.append(t & MASK)
             c = t >> LIMB_BITS
@@ -171,7 +182,9 @@ class LimbField:
 
     @staticmethod
     def _cond_sub(a, m_col, unroll=True):
-        """a - m if a >= m else a; a carried, m a (16,1) numpy/jnp column."""
+        """a - m if a >= m else a; a carried, m a (nl,1) numpy/jnp column
+        (row count derived from a — shared by every limb width)."""
+        nl = a.shape[0]
         if unroll == "fori":
             m_col = jnp.asarray(m_col)
 
@@ -186,7 +199,7 @@ class LimbField:
                 )
 
             d, b, _, _ = jax.lax.fori_loop(
-                0, NL, body,
+                0, nl, body,
                 (jnp.zeros_like(a), jnp.zeros_like(a[0:1]), a, m_col),
             )
             return jnp.where(b == 0, d, a)
@@ -201,7 +214,7 @@ class LimbField:
             )
             return jnp.where(b == 0, d, a)
         rows, b = [], jnp.zeros_like(a[0:1])
-        for i in range(NL):
+        for i in range(nl):
             t = a[i : i + 1] - m_col[i] - b
             rows.append(t & MASK)
             b = t >> 31
@@ -228,7 +241,7 @@ class LimbField:
                 )
 
             out, _, _, _ = jax.lax.fori_loop(
-                0, NL, body,
+                0, b.shape[0], body,
                 (jnp.zeros_like(b), jnp.zeros_like(b[0:1]), b, p2),
             )
             return out
@@ -243,7 +256,7 @@ class LimbField:
             )
             return out
         rows, brw = [], jnp.zeros_like(b[0:1])
-        for i in range(NL):
+        for i in range(b.shape[0]):
             t = p2[i] - b[i : i + 1] - brw
             rows.append(t & MASK)
             brw = t >> 31
@@ -256,45 +269,48 @@ class LimbField:
 
     def mul(self, a, b, p, unroll=True):
         """Montgomery product, CIOS with lazy carries; inputs < 2p (limbs
-        <= 0xffff) -> output < 2p. 16 rounds of dense (16, n) ops, one
+        <= 0xffff) -> output < 2p. nl rounds of dense (nl, n) ops, one
         final carry chain, no conditional subtract."""
+        nl = self.nl
         n = a.shape[-1]
         z1 = jnp.zeros((1, n), jnp.uint32)
 
         def step(v, ai):
-            prod = ai * b  # (16, n); both operands <= 0xffff
-            # rows 1..15 receive lo[1:] + hi[:-1]: merge before widening
+            prod = ai * b  # (nl, n); both operands <= 0xffff
+            # rows 1..nl-1 receive lo[1:] + hi[:-1]: merge before widening
             mid = (prod[1:] & MASK) + (prod[:-1] >> LIMB_BITS)
             contrib = jnp.concatenate(
-                [prod[0:1] & MASK, mid, prod[15:16] >> LIMB_BITS], axis=0
+                [prod[0:1] & MASK, mid, prod[nl - 1 : nl] >> LIMB_BITS],
+                axis=0,
             )
             v = v + contrib
             m = (v[0:1] * self.n0) & MASK
             qp = m * p
             qmid = (qp[1:] & MASK) + (qp[:-1] >> LIMB_BITS)
             qcontrib = jnp.concatenate(
-                [qp[0:1] & MASK, qmid, qp[15:16] >> LIMB_BITS], axis=0
+                [qp[0:1] & MASK, qmid, qp[nl - 1 : nl] >> LIMB_BITS],
+                axis=0,
             )
             v = v + qcontrib
             return jnp.concatenate(
                 [v[1:2] + (v[0:1] >> LIMB_BITS), v[2:], z1], axis=0
             )
 
-        v0 = jnp.zeros((NL + 1, n), jnp.uint32)
+        v0 = jnp.zeros((nl + 1, n), jnp.uint32)
         if unroll == "fori":
             def body(i, st):
                 v, ar = st
                 return step(v, ar[0:1]), _rot(ar)
 
-            v, _ = jax.lax.fori_loop(0, NL, body, (v0, a))
+            v, _ = jax.lax.fori_loop(0, nl, body, (v0, a))
             return self.carry(v, unroll="fori")
         if not unroll:
             v, _ = jax.lax.scan(
-                lambda v, ai: (step(v, ai[None]), None), v0, a[:NL]
+                lambda v, ai: (step(v, ai[None]), None), v0, a[:nl]
             )
             return self.carry(v, unroll=False)
         v = v0
-        for i in range(NL):
+        for i in range(nl):
             v = step(v, a[i : i + 1])
         return self.carry(v)
 
@@ -303,7 +319,6 @@ class LimbField:
         return self._cond_sub(a, jnp.asarray(self.p_col))
 
     # -- group-law plumbing --------------------------------------------------
-    CR = NL  # coordinate rows: one Fq element = 16 limb rows
 
     def make_ops(self, p, p2, unroll=True):
         """(mul, add, sub) closures over the consts blocks — the interface
@@ -321,23 +336,23 @@ class LimbField:
         return self.canon(a)
 
     def b3_limbs(self, b) -> np.ndarray:
-        """3*b Montgomery-encoded as a (16, 1) limb column."""
+        """3*b Montgomery-encoded as a (nl, 1) limb column."""
         v = 3 * b * self.mont_r % self.p
-        return np.array(to_limbs(v), np.uint32).reshape(NL, 1)
+        return np.array(to_limbs(v, self.nl), np.uint32).reshape(self.nl, 1)
 
     def one_limbs(self) -> np.ndarray:
-        return np.array(to_limbs(self.mont_r), np.uint32)
+        return np.array(to_limbs(self.mont_r, self.nl), np.uint32)
 
 
 class LimbFq2:
-    """Fq2 = Fq[u]/(u^2 + 1) on limb-major uint32[32, n]: rows 0-15 c0,
-    16-31 c1. Karatsuba over LimbField's redundant-[0, 2p) Montgomery
-    arithmetic — all component ops stay closed in [0, 2p)."""
-
-    CR = 2 * NL
+    """Fq2 = Fq[u]/(u^2 + 1) on limb-major uint32[2*nl, n]: rows 0..nl-1
+    c0, nl..2nl-1 c1. Karatsuba over LimbField's redundant-[0, 2p)
+    Montgomery arithmetic — all component ops stay closed in [0, 2p)."""
 
     def __init__(self, base: LimbField):
         self.fq = base
+        self.nl = base.nl
+        self.CR = 2 * base.nl
         self.p = base.p
         self.p_col = base.p_col
         self.p2_col = base.p2_col
@@ -345,10 +360,11 @@ class LimbFq2:
 
     def make_ops(self, p, p2, unroll=True):
         F = self.fq
+        nl = self.nl
 
         def mul(a, b):
-            a0, a1 = a[0:NL], a[NL:]
-            b0, b1 = b[0:NL], b[NL:]
+            a0, a1 = a[0:nl], a[nl:]
+            b0, b1 = b[0:nl], b[nl:]
             t0 = F.mul(a0, b0, p, unroll)
             t1 = F.mul(a1, b1, p, unroll)
             c0 = F.sub(t0, t1, p2, unroll)  # u^2 = -1
@@ -363,8 +379,8 @@ class LimbFq2:
         def add(a, b):
             return jnp.concatenate(
                 [
-                    F.add(a[0:NL], b[0:NL], p2, unroll),
-                    F.add(a[NL:], b[NL:], p2, unroll),
+                    F.add(a[0:nl], b[0:nl], p2, unroll),
+                    F.add(a[nl:], b[nl:], p2, unroll),
                 ],
                 axis=0,
             )
@@ -372,8 +388,8 @@ class LimbFq2:
         def sub(a, b):
             return jnp.concatenate(
                 [
-                    F.sub(a[0:NL], b[0:NL], p2, unroll),
-                    F.sub(a[NL:], b[NL:], p2, unroll),
+                    F.sub(a[0:nl], b[0:nl], p2, unroll),
+                    F.sub(a[nl:], b[nl:], p2, unroll),
                 ],
                 axis=0,
             )
@@ -381,33 +397,36 @@ class LimbFq2:
         return mul, add, sub
 
     def neg_rows(self, a, p2, unroll=True):
-        F = self.fq
+        F, nl = self.fq, self.nl
         return jnp.concatenate(
-            [F.neg(a[0:NL], p2, unroll), F.neg(a[NL:], p2, unroll)], axis=0
+            [F.neg(a[0:nl], p2, unroll), F.neg(a[nl:], p2, unroll)], axis=0
         )
 
     def canon_rows(self, a):
-        F = self.fq
-        return jnp.concatenate([F.canon(a[0:NL]), F.canon(a[NL:])], axis=0)
+        F, nl = self.fq, self.nl
+        return jnp.concatenate([F.canon(a[0:nl]), F.canon(a[nl:])], axis=0)
 
     def b3_limbs(self, b) -> np.ndarray:
-        """3*b' Montgomery-encoded as a (32, 1) limb column (b' in Fq2)."""
+        """3*b' Montgomery-encoded as a (2*nl, 1) limb column (b' in Fq2)."""
         b0, b1 = b
+        nl = self.nl
         return np.concatenate(
             [
                 np.array(
-                    to_limbs(3 * b0 * self.mont_r % self.p), np.uint32
-                ).reshape(NL, 1),
+                    to_limbs(3 * b0 * self.mont_r % self.p, nl), np.uint32
+                ).reshape(nl, 1),
                 np.array(
-                    to_limbs(3 * b1 * self.mont_r % self.p), np.uint32
-                ).reshape(NL, 1),
+                    to_limbs(3 * b1 * self.mont_r % self.p, nl), np.uint32
+                ).reshape(nl, 1),
             ],
             axis=0,
         )
 
     def one_limbs(self) -> np.ndarray:
-        one = np.zeros((2 * NL,), np.uint32)
-        one[:NL] = np.array(to_limbs(self.mont_r), np.uint32)
+        one = np.zeros((2 * self.nl,), np.uint32)
+        one[: self.nl] = np.array(
+            to_limbs(self.mont_r, self.nl), np.uint32
+        )
         return one
 
 
@@ -435,10 +454,17 @@ class LimbGroup:
         self.F = field
         self.CR = field.CR
         self.ROWS = 3 * self.CR
-        # Pallas lane tile: halved for Fq2 (double the rows in VMEM)
-        self.tile = tile or (TILE if self.CR == NL else TILE // 2)
+        # base-field limb rows (== CR for Fq, CR/2 for Fq2) — the consts
+        # block and kernel bodies slice by this, not a hardcoded 16
+        self.base_nl = field.p_col.shape[0]
+        # Pallas lane tile: scaled down as rows grow (VMEM budget is
+        # rows x tile), floored to a power of two
+        if tile is None:
+            tile = max(256, TILE * (3 * NL) // self.ROWS)
+            tile = 1 << (tile.bit_length() - 1)
+        self.tile = tile
         # consts block handed to every kernel:
-        # rows 0-15 p, 16-31 2p, 32..32+CR b3 (Montgomery)
+        # rows [0:bn] p, [bn:2bn] 2p, [2bn:2bn+CR] b3 (Montgomery)
         self.consts_np = np.concatenate(
             [field.p_col, field.p2_col, field.b3_limbs(b)], axis=0
         )
@@ -451,8 +477,8 @@ class LimbGroup:
     # -- bodies -------------------------------------------------------------
 
     def add_body(self, p3, q3, consts, unroll=True):
-        CR = self.CR
-        p, p2, b3c = consts[0:16], consts[16:32], consts[32:]
+        CR, bn = self.CR, self.base_nl
+        p, p2, b3c = consts[0:bn], consts[bn : 2 * bn], consts[2 * bn :]
         mul, add, sub = self.F.make_ops(p, p2, unroll)
         X1, Y1, Z1 = p3[0:CR], p3[CR : 2 * CR], p3[2 * CR :]
         X2, Y2, Z2 = q3[0:CR], q3[CR : 2 * CR], q3[2 * CR :]
@@ -473,8 +499,8 @@ class LimbGroup:
         return jnp.concatenate([X3, Y3, Z3o], axis=0)
 
     def double_body(self, p3, consts, unroll=True):
-        CR = self.CR
-        p, p2, b3c = consts[0:16], consts[16:32], consts[32:]
+        CR, bn = self.CR, self.base_nl
+        p, p2, b3c = consts[0:bn], consts[bn : 2 * bn], consts[2 * bn :]
         mul, add, sub = self.F.make_ops(p, p2, unroll)
         X, Y, Z = p3[0:CR], p3[CR : 2 * CR], p3[2 * CR :]
         t0 = mul(Y, Y)
@@ -496,8 +522,8 @@ class LimbGroup:
         return jnp.concatenate([X3, Y3, Z3], axis=0)
 
     def neg_body(self, p3, consts):
-        CR = self.CR
-        p2 = consts[16:32]
+        CR, bn = self.CR, self.base_nl
+        p2 = consts[bn : 2 * bn]
         return jnp.concatenate(
             [
                 p3[0:CR],
@@ -689,8 +715,9 @@ class LimbGroup:
 
     @property
     def rm_shape(self) -> tuple:
-        """Trailing row-major point shape: (3, 16) G1, (3, 2, 16) G2."""
-        return (3, 16) if self.CR == NL else (3, 2, 16)
+        """Trailing row-major point shape: (3, nl) G1, (3, 2, nl) G2."""
+        bn = self.base_nl
+        return (3, bn) if self.CR == bn else (3, 2, bn)
 
     def from_rowmajor(self, pts):
         """(n,) + rm_shape row-major (canonical Montgomery) -> (ROWS, n)."""
@@ -731,31 +758,65 @@ def lg2() -> LimbGroup:
     return LimbGroup(lfq2(), G2_B)
 
 
+# BLS12-377/381 limb groups: same bodies/kernels at 24 base-field limb
+# rows (radix 2^384). The PrimeField configs in ops/bls12_377.py /
+# ops/bls12_381.py stay the row-major source of truth; these are the
+# Pallas-path mirrors, keyed off the same derived constants.
+
+
+@functools.cache
+def lg1_377() -> LimbGroup:
+    from .bls12_377 import G1_B377, Q377, fq377
+
+    return LimbGroup(LimbField(Q377, fq377().nl), G1_B377)
+
+
+@functools.cache
+def lg1_381() -> LimbGroup:
+    from .bls12_381 import G1_B381, Q381, fq381
+
+    return LimbGroup(LimbField(Q381, fq381().nl), G1_B381)
+
+
+@functools.cache
+def lg2_381() -> LimbGroup:
+    from .bls12_381 import G2_B381, Q381, fq381
+
+    return LimbGroup(LimbFq2(LimbField(Q381, fq381().nl)), G2_B381)
+
+
 # ---------------------------------------------------------------------------
 # Tree MSM: sorted-digit buckets, pairwise sum tree + Fenwick prefix queries
 # ---------------------------------------------------------------------------
 
 
 def _digits(scalars_std, c: int):
-    """(n, 16) standard-form u32 limbs -> (W, n) int32 c-bit digits, LSB
-    window first. c must divide 16."""
+    """(n, nl) standard-form u32 limbs -> (W, n) int32 c-bit digits, LSB
+    window first, W = nl*16/c. c must divide 16. Width-aware: wider
+    scalar layouts (17-limb r381 standard form) just produce more
+    (all-zero) top windows — no truncation."""
     assert LIMB_BITS % c == 0
     per = LIMB_BITS // c
+    nl_s = scalars_std.shape[1]
     parts = [
         ((scalars_std >> (k * c)) & ((1 << c) - 1)) for k in range(per)
-    ]  # each (n, 16)
-    inter = jnp.stack(parts, axis=-1).reshape(scalars_std.shape[0], 16 * per)
+    ]  # each (n, nl)
+    inter = jnp.stack(parts, axis=-1).reshape(
+        scalars_std.shape[0], nl_s * per
+    )
     return jnp.transpose(inter).astype(jnp.int32)  # (W, n)
 
 
 def msm_tree(points_rm, scalars_std, c: int | None = None,
-             window_group: int | None = None):
-    """sum_i scalars[i] * points[i] on BN254 G1 or G2, limb-major TPU path.
+             window_group: int | None = None, group: "LimbGroup" = None):
+    """sum_i scalars[i] * points[i], limb-major TPU path (any LimbGroup).
 
-    points_rm: (n, 3, 16) G1 / (n, 3, 2, 16) G2 projective row-major
-    (Montgomery, canonical) — the group is inferred from the rank;
-    scalars_std: (n, 16) uint32 standard form. Returns (3, 16) or
-    (3, 2, 16) row-major canonical projective point.
+    points_rm: (n, 3, nl) G1 / (n, 3, 2, nl) G2 projective row-major
+    (Montgomery, canonical) — BN254 groups are inferred from the rank
+    when `group` is omitted; other curves pass their LimbGroup
+    (lg1_377() / lg1_381() / lg2_381());
+    scalars_std: (n, k) uint32 standard form (k*16 >= scalar bits).
+    Returns the (3, ...) row-major canonical projective sum.
 
     Per window: points are ordered by digit (argsort), reduced by a pairwise
     sum tree (n-1 adds — vs 2n for an associative_scan — with every level a
@@ -774,7 +835,7 @@ def msm_tree(points_rm, scalars_std, c: int | None = None,
         # the Fenwick/combine stages scale with B = 2^c per window: a small
         # MSM with c=8 would spend everything on 255 empty buckets
         c = 8 if points_rm.shape[0] >= 4096 else 4
-    g = lg2() if points_rm.ndim == 4 else lg1()
+    g = group or (lg2() if points_rm.ndim == 4 else lg1())
     return _msm_tree_jit(g, points_rm, scalars_std, c, window_group)
 
 
@@ -783,7 +844,7 @@ def _msm_tree_jit(g: LimbGroup, points_rm, scalars_std, c: int,
                   window_group: int | None):
     RR = g.ROWS
     n = points_rm.shape[0]
-    W_all = 256 // c
+    W_all = scalars_std.shape[1] * LIMB_BITS // c
     B = 1 << c
     npad = 1 << max(1, (n - 1).bit_length())
     lm = g.from_rowmajor(points_rm)

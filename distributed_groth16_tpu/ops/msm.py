@@ -102,25 +102,44 @@ def _msm_ladder_jit(curve: CurvePoints, points, scalars):
     return curve.sum_sequential(acc, axis=0)
 
 
-def _tree_path_ok(curve: CurvePoints, n: int) -> bool:
-    """Route BN254 G1 AND G2 MSMs to the limb-major tree path
-    (ops/limb_kernels.py) on TPU backends — the Pallas fast path — or
-    anywhere when forced via DG16_FORCE_TREE_MSM=1 (tests exercise the
-    identical XLA bodies on CPU)."""
-    import os
-
+def _limb_group_for(curve: CurvePoints):
+    """The LimbGroup factory matching this curve's base field + extension
+    degree, or None for unsupported configurations. BN254 and
+    BLS12-377/381 all ride the same limb machinery (LimbField is
+    limb-count-generic as of r5)."""
+    from . import limb_kernels as lk
     from .constants import Q as _BN254_Q
 
-    if curve.elem_shape not in ((N_LIMBS,), (2, N_LIMBS)):
-        return False
     base_p = curve.F.p if hasattr(curve.F, "p") else curve.F.fq.p
-    if base_p != _BN254_Q:
-        return False  # limb singletons are BN254-moduli; see lfq()/lfq2()
+    ext2 = len(curve.elem_shape) == 2
+    if base_p == _BN254_Q:
+        return lk.lg2 if ext2 else lk.lg1
+    from .bls12_377 import Q377
+    from .bls12_381 import Q381
+
+    if base_p == Q377 and not ext2:
+        return lk.lg1_377
+    if base_p == Q381:
+        return lk.lg2_381 if ext2 else lk.lg1_381
+    return None
+
+
+def _tree_group(curve: CurvePoints, n: int):
+    """The LimbGroup to run this MSM's limb-major tree path on, or None
+    for the generic row-major path. TPU backends route every supported
+    curve here — the Pallas fast path; DG16_FORCE_TREE_MSM=1 forces it
+    anywhere (tests exercise the identical XLA bodies on CPU)."""
+    import os
+
+    factory = _limb_group_for(curve)
+    if factory is None:
+        return None
     if os.environ.get("DG16_FORCE_TREE_MSM") == "1":
-        return True
+        return factory()
     from .limb_kernels import use_pallas
 
-    return use_pallas() and n >= 1024
+    return factory() if (use_pallas() and n >= 1024) else None
+
 
 
 def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
@@ -136,13 +155,21 @@ def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
     Returns a single projective point (3,) + elem_shape.
     """
     n = points.shape[0]
-    assert scalars.shape[-1] == N_LIMBS and scalars.shape[0] == n
+    # scalar layouts wider than 16 limbs (r381's 17-limb standard form)
+    # are accepted: every supported scalar order is < 2^256, so the extra
+    # limbs are zero; the tree path's digit decomposition is width-aware
+    # and the Pippenger/ladder paths read 256 bits
+    assert scalars.shape[-1] >= N_LIMBS and scalars.shape[0] == n
     # explicit window_bits/chunk pin the generic path (chunk in particular
     # is a memory bound the tree path would silently drop)
-    if window_bits is None and chunk is None and _tree_path_ok(curve, n):
+    tree_g = (
+        _tree_group(curve, n) if window_bits is None and chunk is None
+        else None
+    )
+    if tree_g is not None:
         from .limb_kernels import msm_tree
 
-        return msm_tree(points, scalars)
+        return msm_tree(points, scalars, group=tree_g)
     if window_bits is None and chunk is None and n <= _LADDER_MSM_MAX_N:
         return _msm_ladder_jit(curve, points, scalars)
     if window_bits is None:
@@ -168,11 +195,15 @@ def msm_batched(curve: CurvePoints, bases, scalars_std):
     Python loop of Pippengers put B bodies in the traced graph and the
     m=4096 mesh-prover compile took 13+ minutes)."""
     B, n = scalars_std.shape[0], scalars_std.shape[1]
-    if _tree_path_ok(curve, n):
+    tree_g = _tree_group(curve, n)
+    if tree_g is not None:
         from .limb_kernels import msm_tree
 
         return jnp.stack(
-            [msm_tree(bases[b], scalars_std[b]) for b in range(B)]
+            [
+                msm_tree(bases[b], scalars_std[b], group=tree_g)
+                for b in range(B)
+            ]
         )
     if n <= _LADDER_MSM_MAX_N:
         from .curve import scalar_bits

@@ -43,12 +43,9 @@ async def d_msm(
     F = scalar_field or fr()
     log.debug("d_msm: party %d local MSM over %d bases (sid=%d)",
               net.party_id, bases.shape[0], sid)
+    # wide standard forms (r381 -> 17 limbs) pass through unchanged:
+    # ops/msm.py's digit decomposition is width-aware as of r5
     std = F.from_mont(scalar_shares)
-    if std.shape[-1] > 16:
-        # fields with >256-bit Montgomery radix (r381 -> 17 limbs) carry
-        # zero top limbs in standard form (r < 2^256); the MSM digit
-        # machinery is 16-limb/256-bit
-        std = std[..., :16]
     local = msm(curve, bases, std)
 
     def king(points):

@@ -255,13 +255,13 @@ class PackedSharingParams:
         # TPU fast path: run the ladder limb-major so every add/double in
         # the nbits-step sweep rides the Pallas kernels — CRS packing was
         # 74% of the million-2^12 wall-clock on the row-major path.
-        from ..ops.msm import _tree_path_ok
+        from ..ops.msm import _tree_group
 
         B = int(np.prod(batch, dtype=np.int64)) if batch else 1
-        if _tree_path_ok(curve, B * o * K):
-            from ..ops.limb_kernels import ladder_apply_jit, lg1, lg2
+        g = _tree_group(curve, B * o * K)
+        if g is not None:
+            from ..ops.limb_kernels import ladder_apply_jit
 
-            g = lg1() if curve.coord_axes == 1 else lg2()
             rm_flat = base.reshape((B * K,) + (3,) + curve.elem_shape)
             lm = g.from_rowmajor(rm_flat).reshape(g.ROWS, B, K)
             out_lm = ladder_apply_jit(g, lm, bits, signs, nbits)

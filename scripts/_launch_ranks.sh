@@ -1,0 +1,54 @@
+# Shared rank-spawning harness for the per-kernel launcher matrix
+# (dfft_test.sh, dmsm_bench.sh, dpp_test.sh, million.sh) — the role the
+# reference's scripts/{dfft_test,dmsm_bench,dpp_test,million}.zsh share:
+# generate certs + address file, spawn N ranks of a given example,
+# wait for all, propagate any failure. Sourced, not executed.
+#
+# Caller sets: EXAMPLE (python file), EXTRA_ARGS (array, per-rank args
+# appended after --id/--input/--certs/--n). Honors N, PORT, PLAIN,
+# WORK_DIR, NL_PLATFORM like nonlocal_sha256.sh.
+
+set -euo pipefail
+
+N=${N:-8}
+PORT=${PORT:-9805}
+WORK=${WORK_DIR:-$(mktemp -d)}
+if [ -z "${WORK_DIR:-}" ]; then trap 'rm -rf "$WORK"' EXIT; fi
+
+TLS_ARGS=()
+if [ "${PLAIN:-0}" = "1" ]; then
+  TLS_ARGS+=(--plain)
+else
+  for i in $(seq 0 $((N - 1))); do
+    python -m distributed_groth16_tpu.utils.certs "$i" "$WORK/certs" >/dev/null
+  done
+fi
+
+ADDR="$WORK/addresses"
+: > "$ADDR"
+for i in $(seq 0 $((N - 1))); do
+  echo "127.0.0.1:$((PORT + i))" >> "$ADDR"
+done
+
+# the axon TPU plugin can hang backend init when PALLAS_AXON_POOL_IPS is
+# set; ranks run on the CPU backend unless NL_PLATFORM overrides
+unset PALLAS_AXON_POOL_IPS
+PIDS=()
+for i in $(seq $((N - 1)) -1 0); do
+  JAX_PLATFORMS=${NL_PLATFORM:-cpu} python "$EXAMPLE" \
+    --id "$i" --input "$ADDR" --certs "$WORK/certs" --n "$N" \
+    "${EXTRA_ARGS[@]}" "${TLS_ARGS[@]}" \
+    > "$WORK/rank$i.log" 2>&1 &
+  PIDS+=($!)
+done
+
+STATUS=0
+for pid in "${PIDS[@]}"; do
+  wait "$pid" || STATUS=1
+done
+grep -h "rank 0:" "$WORK"/rank*.log || true
+if [ "$STATUS" -ne 0 ]; then
+  echo "$(basename "$EXAMPLE"): FAILED — logs:"
+  tail -n 20 "$WORK"/rank*.log
+  exit 1
+fi

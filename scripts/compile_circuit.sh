@@ -1,0 +1,50 @@
+#!/bin/bash
+# Circuit regeneration — analog of the reference's scripts/compile_circuit.sh
+# (circom -> .r1cs/.wasm/.sym for fixtures/*.circom).
+#
+# The circom compiler is an EXTERNAL toolchain (Rust binary / npm package)
+# that this image does not ship, and the framework deliberately does not
+# reimplement it: the framework's ingestion boundary is the COMPILED
+# artifact pair (.r1cs + .wasm), which frontend/readers.py and
+# frontend/wasm_vm.py consume natively. If circom is on PATH this script
+# performs the same compilation the reference's does; otherwise it
+# documents the exact command so the artifacts can be produced on any
+# machine with circom and copied in.
+#
+# Everything DOWNSTREAM of the artifacts is covered natively:
+#   .r1cs/.wasm parsing      frontend/readers.py, frontend/wasm_vm.py
+#   witness generation       frontend/witness_calculator.py (+ csrc C tier)
+#   setup / proving          models/groth16 (no ptau needed: dev setup)
+#   snarkjs interop          frontend/snarkjs.py, frontend/zkey.py
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CIRCUIT=${1:-}
+OUTDIR=${2:-artifacts}
+if [ -z "$CIRCUIT" ]; then
+  echo "usage: scripts/compile_circuit.sh path/to/circuit.circom [outdir]"
+  exit 2
+fi
+
+if ! command -v circom >/dev/null 2>&1; then
+  cat <<EOF
+circom not found on PATH.
+
+This environment does not ship the circom compiler; compile the circuit
+on a machine that has it (https://docs.circom.io):
+
+    circom --r1cs --wasm --sym -o $OUTDIR $CIRCUIT
+
+then copy the resulting .r1cs and _js/*.wasm pair here. The framework
+consumes them directly:
+
+    from distributed_groth16_tpu.frontend.builder import CircomConfig
+    cfg = CircomConfig("$OUTDIR/<name>_js/<name>.wasm", "$OUTDIR/<name>.r1cs")
+EOF
+  exit 3
+fi
+
+echo "Compiling $CIRCUIT"
+mkdir -p "$OUTDIR"
+circom --r1cs --wasm --sym -o "$OUTDIR" "$CIRCUIT"
+echo "Done"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Distributed FFT over real sockets, 8 OS processes — the reference's
+# scripts/dfft_test.zsh (dist-primitives/examples/dfft_test.rs launcher).
+#   ./scripts/dfft_test.sh            # m=256 smoke
+#   M=4096 ./scripts/dfft_test.sh    # bigger transform
+cd "$(dirname "$0")/.."
+EXAMPLE=examples/nonlocal_kernel.py
+EXTRA_ARGS=(--kernel dfft --m "${M:-256}")
+source scripts/_launch_ranks.sh
+echo "dfft_test: OK"

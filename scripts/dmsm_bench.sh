@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Distributed MSM over real sockets, 8 OS processes — the reference's
+# scripts/dmsm_bench.zsh (dist-primitives/examples/dmsm_bench.rs launcher).
+#   ./scripts/dmsm_bench.sh           # m=64 smoke
+#   M=1024 ./scripts/dmsm_bench.sh   # bigger MSM
+cd "$(dirname "$0")/.."
+EXAMPLE=examples/nonlocal_kernel.py
+EXTRA_ARGS=(--kernel dmsm --m "${M:-64}")
+source scripts/_launch_ranks.sh
+echo "dmsm_bench: OK"

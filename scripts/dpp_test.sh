@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Distributed partial products over real sockets, 8 OS processes — the
+# reference's scripts/dpp_test.zsh (dist-primitives/examples/dpp_test.rs
+# launcher).
+#   ./scripts/dpp_test.sh             # m=128 smoke
+#   M=2048 ./scripts/dpp_test.sh     # bigger vector
+cd "$(dirname "$0")/.."
+EXAMPLE=examples/nonlocal_kernel.py
+EXTRA_ARGS=(--kernel dpp --m "${M:-128}")
+source scripts/_launch_ranks.sh
+echo "dpp_test: OK"

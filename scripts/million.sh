@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Million-constraint workload over real sockets, 8 OS processes — the
+# reference's scripts/million.zsh (groth16/examples/million.rs launcher,
+# fixtures/million/million.circom = 2^20 constraints). Runs the full
+# distributed prover on the chain circuit at LOG2 constraints via the
+# nonlocal runner; rank 0 pairing-verifies.
+#   ./scripts/million.sh              # LOG2=10 smoke
+#   LOG2=20 ./scripts/million.sh     # the reference's configuration
+cd "$(dirname "$0")/.."
+export CIRCUIT=chain LOG2=${LOG2:-10}
+exec bash scripts/nonlocal_sha256.sh

@@ -1,0 +1,64 @@
+#!/bin/bash
+# Phase-2 (circuit-specific) proving-key ceremony — analog of the
+# reference's scripts/phase2_proving_key.sh (snarkjs groth16 setup over a
+# powers-of-tau file, contribute, beacon, verify, export).
+#
+# snarkjs is an EXTERNAL npm toolchain this image does not ship. The
+# framework covers the same capability surface two ways:
+#
+#   * dev-grade circuit-specific setup natively on device:
+#     models/groth16/setup.py (seeded, like the reference service's
+#     [42u8;32] dev setup — mpc-api/src/main.rs:148-152). No ptau file.
+#   * REAL-ceremony keys: frontend/zkey.py reads (and writes) snarkjs
+#     .zkey files, so a circuit_final.zkey produced by this exact
+#     ceremony elsewhere drops in via ProvingKey.from_zkey(...).
+#
+# If snarkjs + a ptau file are available this script runs the same
+# ceremony the reference's does; otherwise it prints the recipe.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+R1CS=${1:-}
+PTAU=${2:-powersOfTau28_hez_final_22.ptau}
+OUTDIR=${3:-artifacts}
+if [ -z "$R1CS" ]; then
+  echo "usage: scripts/phase2_proving_key.sh circuit.r1cs [ptau] [outdir]"
+  exit 2
+fi
+
+if ! command -v npx >/dev/null 2>&1 || [ ! -f "$PTAU" ]; then
+  cat <<EOF
+snarkjs (npx) or the ptau file is unavailable here.
+
+Run the ceremony on a machine with node + snarkjs
+(https://github.com/iden3/snarkjs):
+
+    npx snarkjs groth16 setup $R1CS $PTAU $OUTDIR/circuit_0000.zkey
+    echo "test" | npx snarkjs zkey contribute $OUTDIR/circuit_0000.zkey \\
+        $OUTDIR/circuit_0001.zkey --name="1st Contributor" -v
+    npx snarkjs zkey beacon $OUTDIR/circuit_0001.zkey \\
+        $OUTDIR/circuit_final.zkey \\
+        0102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f 10
+    npx snarkjs zkey verify $R1CS $PTAU $OUTDIR/circuit_final.zkey
+    npx snarkjs zkey export verificationkey $OUTDIR/circuit_final.zkey \\
+        $OUTDIR/verification_key.json
+
+then load it here with ProvingKey.from_zkey("$OUTDIR/circuit_final.zkey").
+For development, models/groth16/setup.py produces a working (dev-trust)
+key with no external toolchain at all.
+EOF
+  exit 3
+fi
+
+mkdir -p "$OUTDIR"
+npx snarkjs groth16 setup "$R1CS" "$PTAU" "$OUTDIR/circuit_0000.zkey"
+echo "test" | npx snarkjs zkey contribute "$OUTDIR/circuit_0000.zkey" \
+  "$OUTDIR/circuit_0001.zkey" --name="1st Contributor" -v
+npx snarkjs zkey beacon "$OUTDIR/circuit_0001.zkey" \
+  "$OUTDIR/circuit_final.zkey" \
+  0102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f 10 \
+  -n="Final Beacon phase2"
+npx snarkjs zkey verify "$R1CS" "$PTAU" "$OUTDIR/circuit_final.zkey"
+npx snarkjs zkey export verificationkey "$OUTDIR/circuit_final.zkey" \
+  "$OUTDIR/verification_key.json"
+echo "Done"

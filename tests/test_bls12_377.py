@@ -108,3 +108,31 @@ def test_d_msm_bls12_377_matches_host():
     )
     for o in outs:
         assert C.decode(o) == expected
+
+
+def test_tree_msm_limb_path_matches_host(monkeypatch):
+    # r5: the limb-major tree MSM is limb-count-generic — force it on CPU
+    # (identical XLA bodies) over the 24-limb curve and check against the
+    # pure-bigint host MSM.
+    monkeypatch.setenv("DG16_FORCE_TREE_MSM", "1")
+    import random
+
+    from distributed_groth16_tpu.ops.limb_kernels import lg1_377, msm_tree
+
+    rng = random.Random(7)
+    C = g1_377()
+    n = 32
+    scal = [rng.randrange(R377) for _ in range(n)]
+    pts_host = [
+        G1_HOST.scalar_mul(g1_generator_377(), rng.randrange(R377))
+        for _ in range(n)
+    ]
+    pts = C.encode(pts_host)
+    out = C.decode(msm(C, pts, encode_scalars_377(scal)))
+    expect = G1_HOST.msm(pts_host, scal)
+    assert out == expect
+    # direct tree call too (bypasses routing)
+    direct = C.decode(
+        msm_tree(pts, encode_scalars_377(scal), group=lg1_377())[None]
+    )[0]
+    assert direct == expect

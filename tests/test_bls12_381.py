@@ -180,3 +180,33 @@ def test_d_msm_bls12_381_matches_host():
     )
     for o in outs:
         assert C.decode(o) == expected
+
+
+def test_tree_msm_limb_path_matches_host_381(monkeypatch):
+    # r5: limb-count-generic tree MSM over BLS12-381 G1 (24 limbs) with
+    # the 17-limb r381 standard scalar form — width-aware digits, no
+    # truncation.
+    monkeypatch.setenv("DG16_FORCE_TREE_MSM", "1")
+    import random
+
+    from distributed_groth16_tpu.ops.bls12_381 import (
+        G1_HOST,
+        R381,
+        encode_scalars_381,
+        g1_381,
+        g1_generator_381,
+    )
+    from distributed_groth16_tpu.ops.msm import msm
+
+    rng = random.Random(11)
+    C = g1_381()
+    n = 16
+    scal = [rng.randrange(R381) for _ in range(n)]
+    pts_host = [
+        G1_HOST.scalar_mul(g1_generator_381(), rng.randrange(R381))
+        for _ in range(n)
+    ]
+    pts = C.encode(pts_host)
+    out = C.decode(msm(C, pts, encode_scalars_381(scal)))
+    expect = G1_HOST.msm(pts_host, scal)
+    assert out == expect
