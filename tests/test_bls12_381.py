@@ -210,3 +210,26 @@ def test_tree_msm_limb_path_matches_host_381(monkeypatch):
     out = C.decode(msm(C, pts, encode_scalars_381(scal)))
     expect = G1_HOST.msm(pts_host, scal)
     assert out == expect
+
+
+@pytest.mark.slow
+def test_tree_msm_limb_path_g2_381(monkeypatch):
+    # r5: lg2_381 — the Fq2/24-limb limb group — through the forced tree
+    # path vs the host G2 MSM.
+    monkeypatch.setenv("DG16_FORCE_TREE_MSM", "1")
+    from distributed_groth16_tpu.ops.bls12_381 import (
+        G2_HOST,
+        R381,
+        encode_scalars_381,
+        g2_381,
+        g2_generator_381,
+    )
+    from distributed_groth16_tpu.ops.msm import msm
+
+    C, gen = g2_381(), g2_generator_381()
+    n = 8
+    ks = [(7 * k + 3) % R381 for k in range(1, n + 1)]
+    scal = [(k * k + 1) % R381 for k in range(1, n + 1)]
+    pts_host = [G2_HOST.scalar_mul(gen, k) for k in ks]
+    out = C.decode(msm(C, C.encode(pts_host), encode_scalars_381(scal)))
+    assert out == G2_HOST.msm(pts_host, scal)
