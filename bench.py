@@ -39,10 +39,12 @@ ARKWORKS_CPU_MSM_PER_SEC = 1.0e6  # documented ballpark, see module docstring
 
 _PRINTED = False
 _PRINT_LOCK = threading.Lock()
-# Captured at import, NOT via limb_kernels: _emit runs from the SIGTERM
-# handler, where a module import could deadlock on the import lock if the
-# main thread holds it (and limb_kernels reads the env once at import
-# anyway, so this string is authoritative for the process).
+# Pre-import fallback for the SIGTERM path (a module import inside a
+# signal handler could deadlock on the import lock). main() overwrites it
+# with limb_kernels._ROLL_MODE the moment the kernels are imported, so the
+# emitted pallas_roll field reports the mode the kernels actually captured
+# — the two captures can diverge if the env is mutated between the two
+# module imports (programmatic/test use).
 _ROLL_MODE = os.environ.get("DG16_PALLAS_ROLL", "fori")
 
 
@@ -60,14 +62,27 @@ def _emit(
     global _PRINTED
     got = _PRINT_LOCK.acquire(timeout=5.0) if from_signal \
         else _PRINT_LOCK.acquire()
+    if not got:
+        # SIGTERM landed while a thread is INSIDE _do_emit (lock held,
+        # likely mid-print) and the handler will os._exit right after we
+        # return. If the holder is the watchdog thread, the brief sleep
+        # lets it finish and the extra newline is a harmless blank line.
+        # If the holder is the main thread (the handler interrupted it),
+        # nothing can make it finish — the newline then TERMINATES the
+        # partial record, so the consumer always reads newline-ended
+        # lines (one of which may be incomplete JSON) instead of a
+        # stream cut mid-record.
+        time.sleep(1.0)
+        sys.stdout.write("\n")
+        sys.stdout.flush()
+        return
     try:
         if _PRINTED:
             return
         _PRINTED = True
         _do_emit(res, stage_s, platform)
     finally:
-        if got:
-            _PRINT_LOCK.release()
+        _PRINT_LOCK.release()
 
 
 def _do_emit(res: dict, stage_s: dict, platform: str) -> None:
@@ -146,10 +161,17 @@ def main() -> None:
 
     from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
     from distributed_groth16_tpu.ops.curve import g1
+    from distributed_groth16_tpu.ops import limb_kernels
     from distributed_groth16_tpu.ops.limb_kernels import _msm_tree_jit, lg1
     from distributed_groth16_tpu.ops.msm import encode_scalars_std
 
     from distributed_groth16_tpu.utils.benchtools import marginal_cost
+
+    # one authoritative roll-mode capture: whatever limb_kernels read at
+    # ITS import is what the kernels run with — mirror it into the global
+    # the (possibly signal-driven) emit path reports
+    global _ROLL_MODE
+    _ROLL_MODE = limb_kernels._ROLL_MODE
 
     inner = _msm_tree_jit.__wrapped__
     rng = np.random.default_rng(0)

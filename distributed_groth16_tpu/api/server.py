@@ -166,7 +166,7 @@ class ApiServer:
                 with phase("packing", timings):
                     comp = CompiledR1CS(r1cs)
                     qap_shares = comp.qap(z_mont).pss(pp)
-                    crs_shares = pack_proving_key(pk, pp)
+                    crs_shares = pack_proving_key(pk, pp, strip=True)
                     ni = r1cs.num_instance
                     a_sh = pack_from_witness(pp, z_mont[1:])
                     ax_sh = pack_from_witness(pp, z_mont[ni:])

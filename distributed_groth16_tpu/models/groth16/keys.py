@@ -50,11 +50,27 @@ class ProvingKey:
     # the r4 CPU bottleneck (84% of million-2^13 wall-clock). Not
     # persisted by save(): a loaded key (external CRS) has None and
     # packs via the in-exponent ladder as before.
+    #
+    # SECURITY HAZARD: these are trapdoor-derived values (u_i(tau),
+    # v_i(tau), the l/h scalars). Anyone holding them can forge proofs —
+    # the CRS soundness assumption is exactly that they are destroyed.
+    # save() deliberately omits them, but ANY other serialization or
+    # transport of a live ProvingKey object (pickle, cross-process
+    # handoff, a debug dump) would leak them. Call strip() the moment
+    # the dealer no longer needs the fast pack route — one-shot flows
+    # should use pack_proving_key(..., strip=True).
     query_scalars: object | None = None
 
     @property
     def num_wires(self) -> int:
         return self.a_query.shape[0]
+
+    def strip(self) -> "ProvingKey":
+        """Destroy the trapdoor-derived query_scalars (see the field's
+        hazard note). After this the key packs via the in-exponent point
+        route, like a loaded external CRS. Returns self for chaining."""
+        self.query_scalars = None
+        return self
 
     def save(self, path: str) -> None:
         """Persist to one .npz (the mpc-api artifact-store format,

@@ -127,14 +127,23 @@ class PackedProvingKeyShare:
 
 
 def pack_proving_key(
-    pk: ProvingKey, pp: PackedSharingParams
+    pk: ProvingKey, pp: PackedSharingParams, strip: bool = False
 ) -> list[PackedProvingKeyShare]:
     """All-party CRS shares (proving_key.rs:35-110). Takes the scalar
     route when the key carries its dealer scalars (in-process setup),
-    the in-exponent point route otherwise (external CRS)."""
+    the in-exponent point route otherwise (external CRS).
+
+    strip=True clears pk.query_scalars once they have been consumed —
+    they are trapdoor-derived (see ProvingKey.strip's hazard note), so
+    one-shot dealer flows should not keep them alive on a key object
+    that may later cross a trust boundary. Leave False only when the
+    same key must be re-packed (e.g. for another packing factor)."""
     qs = getattr(pk, "query_scalars", None)
     if qs is not None:
-        return pack_proving_key_from_scalars(qs, pp)
+        shares = pack_proving_key_from_scalars(qs, pp)
+        if strip:
+            pk.strip()
+        return shares
     C1, C2 = g1(), g2()
     s_all = _pack_query(C1, pp, pk.a_query[1:])
     u_all = _pack_query(C1, pp, pk.h_query)

@@ -23,13 +23,18 @@ MpcSerNet) is only needed at a real process boundary and lives with the
 gRPC/TLS transport; in-process backends hand device buffers over directly —
 zero-copy, no host round-trip.
 
+Fault tolerance: every collective takes a per-op `timeout=` (falling back to
+the net's NetConfig.op_timeout_s) and raises a structured MpcNetError —
+MpcTimeoutError / MpcDisconnectError carrying (party, peer, sid, op) —
+instead of hanging on a dead or silent peer. See docs/ROBUSTNESS.md.
+
 Backends:
   * LocalSimNet — n asyncio tasks + in-memory queues, the LocalTestNet /
     ChannelIO analog (mpc-net/src/multi.rs:227, prod.rs:409-491) used by all
-    distributed tests. Harness: `simulate_network_round` (multi.rs:289-316).
-  * planned: a sharded single-program mesh backend (parties = mesh shards,
-    collectives = XLA all_gather/ppermute over ICI) and a TLS star over DCN
-    for true multi-host MPC.
+    distributed tests. Harness: `simulate_network_round` (multi.rs:289-316);
+    `run_round_with_retries` re-runs a round on transient transport faults.
+  * ProdNet (prodnet.py) — the TLS star over real sockets, with reconnect
+    backoff, heartbeats, and frame-level fault detection.
 """
 
 from __future__ import annotations
@@ -37,6 +42,8 @@ from __future__ import annotations
 import asyncio
 import logging
 from typing import Any, Awaitable, Callable, Protocol, Sequence
+
+from ..utils.config import NetConfig
 
 # module-level tracing, the role of the reference's log/env_logger calls
 # throughout mpc-net (multi.rs:149,:182); enable with
@@ -47,7 +54,46 @@ CHANNELS = 3
 
 
 class MpcNetError(RuntimeError):
-    pass
+    """Structured transport failure: names the local party, the peer the
+    op was against, the logical channel, and the collective — so a failed
+    2^20 proving round says *which* socket broke, not just that one did."""
+
+    def __init__(
+        self,
+        msg: str,
+        *,
+        party: int | None = None,
+        peer: int | None = None,
+        sid: int | None = None,
+        op: str | None = None,
+    ):
+        self.party = party
+        self.peer = peer
+        self.sid = sid
+        self.op = op
+        ctx = ", ".join(
+            f"{k}={v}"
+            for k, v in (
+                ("party", party), ("peer", peer), ("sid", sid), ("op", op)
+            )
+            if v is not None
+        )
+        super().__init__(f"{msg} [{ctx}]" if ctx else msg)
+        self.msg = msg
+
+    def with_op(self, op: str) -> "MpcNetError":
+        """Same failure, re-labelled with the enclosing collective."""
+        return type(self)(
+            self.msg, party=self.party, peer=self.peer, sid=self.sid, op=op
+        )
+
+
+class MpcTimeoutError(MpcNetError):
+    """An op exceeded its configured deadline (peer alive but silent)."""
+
+
+class MpcDisconnectError(MpcNetError):
+    """The peer's stream died (EOF, corrupt frame, reported failure)."""
 
 
 class Net(Protocol):
@@ -59,50 +105,117 @@ class Net(Protocol):
     @property
     def is_king(self) -> bool: ...
 
-    async def send_to(self, to: int, value: Any, sid: int = 0) -> None: ...
+    async def send_to(
+        self, to: int, value: Any, sid: int = 0,
+        timeout: float | None = None,
+    ) -> None: ...
 
-    async def recv_from(self, frm: int, sid: int = 0) -> Any: ...
+    async def recv_from(
+        self, frm: int, sid: int = 0, timeout: float | None = None
+    ) -> Any: ...
 
-    async def gather_to_king(self, value: Any, sid: int = 0): ...
+    async def gather_to_king(
+        self, value: Any, sid: int = 0, timeout: float | None = None
+    ): ...
 
-    async def scatter_from_king(self, values, sid: int = 0): ...
+    async def scatter_from_king(
+        self, values, sid: int = 0, timeout: float | None = None
+    ): ...
 
 
 class BaseNet:
     """Collectives implemented over send_to/recv_from (as in the reference,
-    where they are trait default methods)."""
+    where they are trait default methods). Subclasses implement
+    `_send_impl` / `_recv_impl`; the deadline + structured-error wrapping
+    lives here so every backend gets it for free."""
 
     party_id: int
     n_parties: int
+    net_cfg: NetConfig | None = None
 
     @property
     def is_king(self) -> bool:
         return self.party_id == 0
 
-    async def send_to(self, to: int, value: Any, sid: int = 0) -> None:
+    async def _send_impl(self, to: int, value: Any, sid: int) -> None:
         raise NotImplementedError
 
-    async def recv_from(self, frm: int, sid: int = 0) -> Any:
+    async def _recv_impl(self, frm: int, sid: int) -> Any:
         raise NotImplementedError
 
-    async def gather_to_king(self, value: Any, sid: int = 0):
+    def _resolve_timeout(self, timeout: float | None) -> float | None:
+        """Per-op override > config default; <= 0 means no deadline."""
+        if timeout is None and self.net_cfg is not None:
+            timeout = self.net_cfg.op_timeout_s
+        if timeout is not None and timeout <= 0:
+            return None
+        return timeout
+
+    async def send_to(
+        self, to: int, value: Any, sid: int = 0,
+        timeout: float | None = None,
+    ) -> None:
+        t = self._resolve_timeout(timeout)
+        try:
+            if t is None:
+                await self._send_impl(to, value, sid)
+            else:
+                await asyncio.wait_for(self._send_impl(to, value, sid), t)
+        except (asyncio.TimeoutError, TimeoutError):
+            raise MpcTimeoutError(
+                f"send deadline ({t}s) exceeded",
+                party=self.party_id, peer=to, sid=sid, op="send_to",
+            ) from None
+
+    async def recv_from(
+        self, frm: int, sid: int = 0, timeout: float | None = None
+    ) -> Any:
+        t = self._resolve_timeout(timeout)
+        try:
+            if t is None:
+                return await self._recv_impl(frm, sid)
+            return await asyncio.wait_for(self._recv_impl(frm, sid), t)
+        except (asyncio.TimeoutError, TimeoutError):
+            raise MpcTimeoutError(
+                f"recv deadline ({t}s) exceeded",
+                party=self.party_id, peer=frm, sid=sid, op="recv_from",
+            ) from None
+
+    async def gather_to_king(
+        self, value: Any, sid: int = 0, timeout: float | None = None
+    ):
         """King returns [v_0, ..., v_{n-1}] (own value at index 0);
         clients send and return None."""
-        if self.is_king:
-            log.debug("gather_to_king: king collecting %d values (sid=%d)",
-                      self.n_parties, sid)
-            out = [value]
-            recvs = [
-                self.recv_from(i, sid) for i in range(1, self.n_parties)
-            ]
-            out.extend(await asyncio.gather(*recvs))
-            return out
-        log.debug("gather_to_king: party %d sending (sid=%d)",
-                  self.party_id, sid)
-        await self.send_to(0, value, sid)
-        return None
+        try:
+            if self.is_king:
+                log.debug("gather_to_king: king collecting %d values (sid=%d)",
+                          self.n_parties, sid)
+                out = [value]
+                recvs = [
+                    asyncio.create_task(self.recv_from(i, sid, timeout=timeout))
+                    for i in range(1, self.n_parties)
+                ]
+                try:
+                    out.extend(await asyncio.gather(*recvs))
+                except BaseException:
+                    # reap the sibling recvs: a leaked task would consume
+                    # a healthy peer's NEXT frame and desync later
+                    # collectives (or raise into the void at its deadline)
+                    for t in recvs:
+                        t.cancel()
+                    await asyncio.gather(*recvs, return_exceptions=True)
+                    raise
+                return out
+            log.debug("gather_to_king: party %d sending (sid=%d)",
+                      self.party_id, sid)
+            await self.send_to(0, value, sid, timeout=timeout)
+            return None
+        except MpcNetError as e:
+            raise e.with_op("gather_to_king") from None
 
-    async def scatter_from_king(self, values, sid: int = 0):
+    async def scatter_from_king(
+        self, values, sid: int = 0, timeout: float | None = None
+    ):
         """King passes one value per party (or None if client); every party
         returns its own value."""
         if self.is_king:
@@ -113,57 +226,80 @@ class BaseNet:
                     f"scatter_from_king: {len(values)} values for "
                     f"{self.n_parties} parties"
                 )
-            log.debug("scatter_from_king: king fanning out %d values "
-                      "(sid=%d)", len(values), sid)
-            sends = [
-                self.send_to(i, values[i], sid)
-                for i in range(1, self.n_parties)
-            ]
-            await asyncio.gather(*sends)
-            return values[0]
-        if values is not None:
-            raise MpcNetError("scatter_from_king: client must pass None")
-        return await self.recv_from(0, sid)
+        try:
+            if self.is_king:
+                log.debug("scatter_from_king: king fanning out %d values "
+                          "(sid=%d)", len(values), sid)
+                sends = [
+                    asyncio.create_task(
+                        self.send_to(i, values[i], sid, timeout=timeout)
+                    )
+                    for i in range(1, self.n_parties)
+                ]
+                try:
+                    await asyncio.gather(*sends)
+                except BaseException:
+                    for t in sends:
+                        t.cancel()
+                    await asyncio.gather(*sends, return_exceptions=True)
+                    raise
+                return values[0]
+            if values is not None:
+                raise MpcNetError("scatter_from_king: client must pass None")
+            return await self.recv_from(0, sid, timeout=timeout)
+        except (MpcTimeoutError, MpcDisconnectError) as e:
+            raise e.with_op("scatter_from_king") from None
 
     async def king_compute(
         self,
         value: Any,
         f: Callable[[list], list],
         sid: int = 0,
+        timeout: float | None = None,
     ):
         """gather -> f on king -> scatter (MpcNet::king_compute)."""
-        gathered = await self.gather_to_king(value, sid)
+        gathered = await self.gather_to_king(value, sid, timeout=timeout)
         out = f(gathered) if gathered is not None else None
-        return await self.scatter_from_king(out, sid)
+        return await self.scatter_from_king(out, sid, timeout=timeout)
 
-    async def broadcast_from_king(self, value: Any, sid: int = 0):
+    async def broadcast_from_king(
+        self, value: Any, sid: int = 0, timeout: float | None = None
+    ):
         """King's value to everyone (the d_msm result fan-out,
         dmsm/mod.rs:94-97)."""
         vals = [value] * self.n_parties if self.is_king else None
-        return await self.scatter_from_king(vals, sid)
+        return await self.scatter_from_king(vals, sid, timeout=timeout)
 
 
 class LocalSimNet(BaseNet):
     """In-process n-party network: one shared mailbox fabric, one instance
     per party. The LocalTestNet role (multi.rs:227-316) without sockets."""
 
-    def __init__(self, party_id: int, n_parties: int, fabric):
+    def __init__(
+        self, party_id: int, n_parties: int, fabric,
+        net_cfg: NetConfig | None = None,
+    ):
         self.party_id = party_id
         self.n_parties = n_parties
         self._fabric = fabric
+        self.net_cfg = net_cfg
 
-    async def send_to(self, to: int, value: Any, sid: int = 0) -> None:
+    async def _send_impl(self, to: int, value: Any, sid: int) -> None:
         if not (0 <= to < self.n_parties) or to == self.party_id:
-            raise MpcNetError(f"bad destination {to}")
+            raise MpcNetError(f"bad destination {to}",
+                              party=self.party_id, peer=to, sid=sid)
         await self._fabric[(self.party_id, to, sid)].put(value)
 
-    async def recv_from(self, frm: int, sid: int = 0) -> Any:
+    async def _recv_impl(self, frm: int, sid: int) -> Any:
         if not (0 <= frm < self.n_parties) or frm == self.party_id:
-            raise MpcNetError(f"bad source {frm}")
+            raise MpcNetError(f"bad source {frm}",
+                              party=self.party_id, peer=frm, sid=sid)
         return await self._fabric[(frm, self.party_id, sid)].get()
 
 
-def make_local_nets(n_parties: int) -> list[LocalSimNet]:
+def make_local_nets(
+    n_parties: int, net_cfg: NetConfig | None = None
+) -> list[LocalSimNet]:
     """One LocalSimNet per party over a fresh shared fabric."""
     fabric = {
         (s, d, c): asyncio.Queue()
@@ -172,19 +308,22 @@ def make_local_nets(n_parties: int) -> list[LocalSimNet]:
         for c in range(CHANNELS)
         if s != d
     }
-    return [LocalSimNet(i, n_parties, fabric) for i in range(n_parties)]
+    return [
+        LocalSimNet(i, n_parties, fabric, net_cfg) for i in range(n_parties)
+    ]
 
 
 def simulate_network_round(
     n_parties: int,
     closure: Callable[[Net, Any], Awaitable[Any]],
     per_party_data: Sequence[Any] | None = None,
+    net_cfg: NetConfig | None = None,
 ) -> list:
     """Run `closure(net, data)` concurrently for every party; return results
     ordered by party id (mpc-net/src/multi.rs:289-316 harness)."""
 
     async def _run():
-        nets = make_local_nets(n_parties)
+        nets = make_local_nets(n_parties, net_cfg)
         tasks = [
             closure(
                 nets[i],
@@ -195,3 +334,41 @@ def simulate_network_round(
         return await asyncio.gather(*tasks)
 
     return asyncio.run(_run())
+
+
+def run_round_with_retries(
+    n_parties: int,
+    closure: Callable[[Net, Any], Awaitable[Any]],
+    per_party_data: Sequence[Any] | None = None,
+    *,
+    retries: int = 2,
+    net_cfg: NetConfig | None = None,
+    on_retry: Callable[[int, MpcNetError], None] | None = None,
+) -> list:
+    """`simulate_network_round` with bounded re-runs on transport faults.
+
+    A transient transport fault (MpcTimeoutError / MpcDisconnectError)
+    re-runs the WHOLE round on a fresh fabric — the retryable-round
+    contract the multi-hour provers need: a flaky link costs one round,
+    not the proof. Application-level exceptions — including plain
+    MpcNetError protocol misuse (bad destination, wrong scatter length),
+    which is deterministic and would fail identically on every re-run —
+    propagate immediately; after `retries` re-runs the last transient
+    error propagates too.
+    """
+    attempts = retries + 1
+    for attempt in range(attempts):
+        try:
+            return simulate_network_round(
+                n_parties, closure, per_party_data, net_cfg
+            )
+        except (MpcTimeoutError, MpcDisconnectError) as e:
+            if attempt == attempts - 1:
+                raise
+            log.warning(
+                "round attempt %d/%d failed (%s); retrying",
+                attempt + 1, attempts, e,
+            )
+            if on_retry is not None:
+                on_retry(attempt, e)
+    raise AssertionError("unreachable")

@@ -7,7 +7,8 @@ asyncio:
   * transport-generic core over an IO-stream interface
     (new_from_pre_existing_connection genericity, prod.rs:97-117,190-243):
     `StreamIO` wraps asyncio TCP/TLS streams, `ChannelIO` is the in-memory
-    fake used by tests (prod.rs:409-491);
+    fake used by tests (prod.rs:409-491), `FaultyIO` (faults.py) wraps
+    either to inject faults for the chaos suite;
   * id handshake: a connecting client writes its u32 id (prod.rs:211);
   * framing: u32 big-endian length prefix (the LengthDelimitedCodec
     convention, multi.rs:26-33) around a 2-byte envelope
@@ -21,6 +22,21 @@ asyncio:
     pin the king's cert (prod.rs:41-78). Python ssl contexts, certs from
     utils/certs.py.
 
+Fault tolerance (see docs/ROBUSTNESS.md):
+  * client dial retries with exponential backoff + jitter under a total
+    startup deadline; the king's accept loop tolerates clients arriving in
+    any order or re-dialing after a failed handshake, and fails fast —
+    naming the missing parties — when the roster is incomplete at the
+    deadline;
+  * HEARTBEAT frames keep idle links observably alive; a peer silent past
+    idle_timeout_s is declared dead;
+  * ERR frames carry a structured abort reason; the king relays a client
+    death to the other clients so the whole star fails fast instead of
+    each rank discovering it by timeout;
+  * any pump failure (EOF, corrupt frame, hostile sid) poisons every
+    (peer, sid) queue with the reason, so pending and future recvs raise
+    MpcDisconnectError instead of hanging forever.
+
 Values are serialized with utils/serde.py (the MpcSerNet typed layer) —
 device arrays cross the wire as raw limb buffers.
 """
@@ -29,6 +45,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import ssl
 import struct
 from typing import Any
@@ -37,13 +54,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import serde
-from .net import CHANNELS, BaseNet, MpcNetError
+from ..utils.config import NetConfig
+from .net import (
+    CHANNELS,
+    BaseNet,
+    MpcDisconnectError,
+    MpcNetError,
+    MpcTimeoutError,
+)
 
 # connection-lifecycle tracing (the reference's env_logger role,
 # mpc-net/src/prod.rs); enable via the "distributed_groth16_tpu" logger
 log = logging.getLogger(__name__)
 
-SYN, SYNACK, DATA = 0, 1, 2
+SYN, SYNACK, DATA, HEARTBEAT, ERR = 0, 1, 2, 3, 4
 
 # Frame-length ceiling: a hostile/corrupt peer must not be able to demand a
 # 4 GB allocation with one u32 header (the reference bounds frames the same
@@ -77,12 +101,16 @@ class StreamIO:
 
 class ChannelIO:
     """In-memory duplex IO over asyncio.Queues — proves the core is
-    transport-generic (the reference's ChannelIO, prod.rs:409-491)."""
+    transport-generic (the reference's ChannelIO, prod.rs:409-491).
+    close() delivers an EOF sentinel so a closed channel behaves like a
+    closed socket (reads fail, they don't hang) — required for the
+    disconnect scenarios of the chaos suite."""
 
     def __init__(self, inbox: asyncio.Queue, outbox: asyncio.Queue):
         self._inbox = inbox
         self._outbox = outbox
         self._buf = b""
+        self._closed = False
 
     @staticmethod
     def pair() -> tuple["ChannelIO", "ChannelIO"]:
@@ -91,15 +119,23 @@ class ChannelIO:
 
     async def read_exactly(self, n: int) -> bytes:
         while len(self._buf) < n:
-            self._buf += await self._inbox.get()
+            chunk = await self._inbox.get()
+            if chunk is None:  # EOF from a closed peer — keep it sticky
+                self._inbox.put_nowait(None)
+                raise ConnectionResetError("channel closed by peer")
+            self._buf += chunk
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 
     async def write(self, data: bytes) -> None:
+        if self._closed:
+            raise ConnectionResetError("channel closed")
         await self._outbox.put(bytes(data))
 
     async def close(self) -> None:
-        pass
+        if not self._closed:
+            self._closed = True
+            self._outbox.put_nowait(None)
 
 
 async def _send_frame(io, packet_type: int, sid: int, payload: bytes) -> None:
@@ -128,13 +164,20 @@ class ProdNet(BaseNet):
     contexts from utils/certs.py for mTLS) or the `from_ios` transport-
     generic constructors."""
 
-    def __init__(self, party_id: int, n_parties: int):
+    def __init__(
+        self, party_id: int, n_parties: int,
+        net_cfg: NetConfig | None = None,
+    ):
         self.party_id = party_id
         self.n_parties = n_parties
+        self.net_cfg = net_cfg if net_cfg is not None else NetConfig.from_env()
         self._ios: dict[int, Any] = {}  # peer id -> IO (clients: only {0})
         self._queues: dict[tuple[int, int], asyncio.Queue] = {}
         self._pumps: list[asyncio.Task] = []
+        self._heartbeats: list[asyncio.Task] = []
         self._dead: set[int] = set()  # peers whose stream died
+        self._death_reason: dict[int, str] = {}
+        self._last_seen: dict[int, float] = {}
         self._closed = False
 
     # -- bring-up ------------------------------------------------------------
@@ -145,19 +188,40 @@ class ProdNet(BaseNet):
         bind: tuple[str, int],
         n_parties: int,
         ssl_context: ssl.SSLContext | None = None,
+        net_cfg: NetConfig | None = None,
     ) -> "ProdNet":
-        """Accept exactly n_parties-1 client connections, read each id
-        handshake, run the Syn/SynAck barrier (prod.rs:135-157)."""
-        self = cls(0, n_parties)
+        """Accept n_parties-1 client connections, read each id handshake,
+        run the Syn/SynAck barrier (prod.rs:135-157). Clients may arrive in
+        any order and re-dial after a failed handshake (the newest
+        connection for an id wins — the old one is presumed dead); if the
+        roster is still incomplete at connect_timeout_s, raises a
+        structured error naming the missing parties."""
+        self = cls(0, n_parties, net_cfg)
+        cfg = self.net_cfg
         accepted: dict[int, StreamIO] = {}
         done = asyncio.Event()
 
         async def on_conn(reader, writer):
             io = StreamIO(reader, writer)
-            (cid,) = struct.unpack("!I", await io.read_exactly(4))
-            if not (1 <= cid < n_parties) or cid in accepted:
+            try:
+                raw = await asyncio.wait_for(
+                    io.read_exactly(4), cfg.connect_timeout_s
+                )
+            except Exception:  # noqa: BLE001 — half-open dial; let it re-try
                 await io.close()
                 return
+            (cid,) = struct.unpack("!I", raw)
+            if not (1 <= cid < n_parties):
+                await io.close()
+                return
+            stale = accepted.pop(cid, None)
+            if stale is not None:
+                # re-dial after a handshake failure: the old connection is
+                # presumed dead — replace it (mTLS pins identity, so a
+                # duplicate id is the same principal, not an impostor)
+                log.warning("king: party %d re-dialed; dropping stale "
+                            "connection", cid)
+                await stale.close()
             accepted[cid] = io
             log.debug("king: accepted party %d (%d/%d)", cid,
                       len(accepted), n_parties - 1)
@@ -167,7 +231,18 @@ class ProdNet(BaseNet):
         server = await asyncio.start_server(
             on_conn, bind[0], bind[1], ssl=ssl_context
         )
-        await done.wait()
+        try:
+            await asyncio.wait_for(done.wait(), cfg.connect_timeout_s)
+        except (asyncio.TimeoutError, TimeoutError):
+            missing = sorted(set(range(1, n_parties)) - set(accepted))
+            server.close()
+            for io in accepted.values():
+                await io.close()
+            raise MpcTimeoutError(
+                f"king: parties {missing} never connected within "
+                f"{cfg.connect_timeout_s}s",
+                party=0, op="new_king",
+            ) from None
         # stop listening; do NOT await wait_closed() — since Python 3.12 it
         # blocks until every accepted connection closes, and ours stay open
         server.close()
@@ -183,11 +258,20 @@ class ProdNet(BaseNet):
         n_parties: int,
         ssl_context: ssl.SSLContext | None = None,
         server_hostname: str | None = None,
-        retries: int = 50,
+        net_cfg: NetConfig | None = None,
     ) -> "ProdNet":
+        """Dial the king with exponential backoff + jitter under the total
+        connect_timeout_s deadline — a client that starts before the king
+        is listening connects as soon as the king comes up."""
         assert party_id != 0
-        self = cls(party_id, n_parties)
-        for attempt in range(retries):
+        self = cls(party_id, n_parties, net_cfg)
+        cfg = self.net_cfg
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + cfg.connect_timeout_s
+        delay = cfg.connect_base_delay_s
+        attempt = 0
+        while True:
+            io = None
             try:
                 reader, writer = await asyncio.open_connection(
                     king_addr[0],
@@ -195,112 +279,264 @@ class ProdNet(BaseNet):
                     ssl=ssl_context,
                     server_hostname=server_hostname if ssl_context else None,
                 )
+                io = StreamIO(reader, writer)
+                await io.write(struct.pack("!I", party_id))  # id handshake
                 break
             except ssl.SSLError:
                 # authentication/misconfig failures are permanent: fail fast
+                if io is not None:
+                    await io.close()
                 raise
-            except OSError:
-                if attempt == retries - 1:
-                    raise
-                await asyncio.sleep(0.2)
-        io = StreamIO(reader, writer)
-        await io.write(struct.pack("!I", party_id))  # id handshake
+            except OSError as e:
+                # a connection whose handshake write failed must be closed
+                # before the re-dial, or every backoff iteration leaks a
+                # socket (and TLS session) for the whole connect window
+                if io is not None:
+                    await io.close()
+                attempt += 1
+                now = loop.time()
+                if now >= deadline:
+                    raise MpcTimeoutError(
+                        f"party {party_id}: king at {king_addr[0]}:"
+                        f"{king_addr[1]} unreachable after {attempt} "
+                        f"dials over {cfg.connect_timeout_s}s "
+                        f"(last error: {e})",
+                        party=party_id, peer=0, op="new_peer",
+                    ) from None
+                sleep = min(delay, cfg.connect_max_delay_s, deadline - now)
+                sleep *= 1.0 + cfg.connect_jitter * random.random()
+                log.debug("party %d: dial %d failed (%s); retrying in "
+                          "%.2fs", party_id, attempt, e, sleep)
+                await asyncio.sleep(sleep)
+                delay *= 2.0
         self._ios = {0: io}
         await self._finish_setup()
         return self
 
     @classmethod
     async def king_from_ios(
-        cls, ios: dict[int, Any], n_parties: int
+        cls, ios: dict[int, Any], n_parties: int,
+        net_cfg: NetConfig | None = None,
     ) -> "ProdNet":
-        self = cls(0, n_parties)
+        self = cls(0, n_parties, net_cfg)
         self._ios = dict(ios)
         await self._finish_setup()
         return self
 
     @classmethod
     async def peer_from_io(
-        cls, party_id: int, io: Any, n_parties: int
+        cls, party_id: int, io: Any, n_parties: int,
+        net_cfg: NetConfig | None = None,
     ) -> "ProdNet":
-        self = cls(party_id, n_parties)
+        self = cls(party_id, n_parties, net_cfg)
         self._ios = {0: io}
         await self._finish_setup()
         return self
 
     async def _finish_setup(self) -> None:
+        loop = asyncio.get_running_loop()
         for peer, io in self._ios.items():
             for sid in range(CHANNELS):
                 self._queues[(peer, sid)] = asyncio.Queue()
+            self._last_seen[peer] = loop.time()
             self._pumps.append(asyncio.create_task(self._pump(peer, io)))
-        await self._synchronize()
+            if self.net_cfg.heartbeat_interval_s > 0:
+                self._heartbeats.append(
+                    asyncio.create_task(self._heartbeat(peer, io))
+                )
+        try:
+            await self._synchronize()
+        except BaseException:
+            # a failed barrier must not leak pumps/heartbeats/sockets on
+            # the half-built node — the caller only ever sees the error
+            await self.close()
+            raise
+
+    def _fail_peer(self, peer: int, reason: str, relay: bool = True) -> None:
+        """Declare a peer dead: poison every (peer, sid) queue so pending
+        AND future recvs raise with the reason, and — king only — relay
+        the death to the other clients via ERR frames so the whole star
+        fails fast instead of each rank timing out independently."""
+        if peer in self._dead or self._closed:
+            return
+        self._dead.add(peer)
+        self._death_reason[peer] = reason
+        log.warning("party %d: stream to peer %d died: %s",
+                    self.party_id, peer, reason)
+        for sid in range(CHANNELS):
+            self._queues[(peer, sid)].put_nowait((None, reason))
+        if relay and self.is_king:
+            msg = f"king relay: party {peer} died ({reason})"
+            for other, io in self._ios.items():
+                if other != peer and other not in self._dead:
+                    # tracked so close() can cancel an unflushed relay
+                    self._pumps.append(
+                        asyncio.create_task(self._send_err(io, msg))
+                    )
+
+    async def _send_err(self, io, reason: str) -> None:
+        try:
+            await _send_frame(io, ERR, 0, serde.dumps(reason))
+        except Exception:  # noqa: BLE001 — best-effort death notice
+            pass
 
     async def _pump(self, peer: int, io) -> None:
         """Per-connection reader: route inbound frames to (peer, sid)
         queues so the logical channels never block each other. ANY failure
         (EOF, malformed frame, bad sid — the peer may be hostile) marks all
-        of the peer's queues dead."""
+        of the peer's queues dead with a descriptive reason."""
+        loop = asyncio.get_running_loop()
         try:
             while True:
                 ptype, sid, payload = await _recv_frame(io)
+                self._last_seen[peer] = loop.time()
+                if ptype == HEARTBEAT:
+                    continue
+                if ptype == ERR:
+                    try:
+                        reason = serde.loads(payload)
+                    except Exception:  # noqa: BLE001 — reason is best-effort
+                        reason = "peer aborted (unreadable ERR payload)"
+                    self._fail_peer(peer, str(reason))
+                    return
                 q = self._queues.get((peer, sid))
                 if q is None:
-                    raise MpcNetError(f"bad sid {sid} from {peer}")
+                    raise MpcNetError(f"bad sid {sid} from {peer}",
+                                      party=self.party_id, peer=peer)
                 await q.put((ptype, payload))
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — death sentinel on every failure
-            log.warning("party %d: stream to peer %d died: %s",
-                        self.party_id, peer, e)
-            self._dead.add(peer)
-            for sid in range(CHANNELS):
-                self._queues[(peer, sid)].put_nowait((None, b"Stream died"))
+            self._fail_peer(peer, f"{type(e).__name__}: {e}")
+
+    async def _heartbeat(self, peer: int, io) -> None:
+        """Keepalive + liveness: send a HEARTBEAT every interval; declare
+        the peer dead if nothing (data or heartbeat) arrived for
+        idle_timeout_s."""
+        cfg = self.net_cfg
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            if self._closed or peer in self._dead:
+                return
+            idle = loop.time() - self._last_seen[peer]
+            if cfg.idle_timeout_s > 0 and idle > cfg.idle_timeout_s:
+                # our own loop may just have resumed from a long
+                # synchronous compute phase with the peer's frames still
+                # sitting in the socket buffer: give the pump a real
+                # scheduling window to drain before declaring death
+                await asyncio.sleep(min(1.0, cfg.heartbeat_interval_s))
+                idle = loop.time() - self._last_seen[peer]
+                if idle <= cfg.idle_timeout_s:
+                    continue
+                self._fail_peer(
+                    peer,
+                    f"idle timeout: no frames from {peer} for "
+                    f"{idle:.1f}s (> {cfg.idle_timeout_s}s)",
+                )
+                return
+            try:
+                await _send_frame(io, HEARTBEAT, 0, b"")
+            except Exception as e:  # noqa: BLE001 — write failure = death
+                self._fail_peer(peer, f"heartbeat write failed: {e}")
+                return
 
     async def _synchronize(self) -> None:
-        """Syn/SynAck barrier (prod.rs:246-296)."""
+        """Syn/SynAck barrier (prod.rs:246-296), bounded by the connect
+        deadline so a peer that dialed but wedged cannot hang bring-up."""
+        try:
+            await asyncio.wait_for(
+                self._synchronize_inner(), self.net_cfg.connect_timeout_s
+            )
+        except (asyncio.TimeoutError, TimeoutError):
+            raise MpcTimeoutError(
+                "Syn/SynAck barrier timed out",
+                party=self.party_id, op="synchronize",
+            ) from None
+
+    async def _synchronize_inner(self) -> None:
         if self.is_king:
             for peer, io in self._ios.items():
                 await _send_frame(io, SYN, 0, b"")
             for peer in self._ios:
-                ptype, _ = await self._queues[(peer, 0)].get()
+                ptype, detail = await self._queues[(peer, 0)].get()
                 if ptype != SYNACK:
-                    raise MpcNetError(f"no SynAck from {peer}")
+                    raise MpcDisconnectError(
+                        f"no SynAck from {peer} ({detail})",
+                        party=0, peer=peer, op="synchronize",
+                    )
         else:
-            ptype, _ = await self._queues[(0, 0)].get()
+            ptype, detail = await self._queues[(0, 0)].get()
             if ptype != SYN:
-                raise MpcNetError("no Syn from king")
+                raise MpcDisconnectError(
+                    f"no Syn from king ({detail})",
+                    party=self.party_id, peer=0, op="synchronize",
+                )
             await _send_frame(self._ios[0], SYNACK, 0, b"")
 
     # -- MpcNet surface ------------------------------------------------------
 
-    async def send_to(self, to: int, value: Any, sid: int = 0) -> None:
+    async def _send_impl(self, to: int, value: Any, sid: int) -> None:
         io = self._ios.get(to)
         if io is None:
             raise MpcNetError(
-                f"party {self.party_id} has no connection to {to} (star)"
+                f"party {self.party_id} has no connection to {to} (star)",
+                party=self.party_id, peer=to, sid=sid,
             )
-        await _send_frame(io, DATA, sid, serde.dumps(_to_wire(value)))
+        if to in self._dead:
+            raise MpcDisconnectError(
+                f"stream to {to} died ({self._death_reason.get(to, '?')})",
+                party=self.party_id, peer=to, sid=sid,
+            )
+        try:
+            await _send_frame(io, DATA, sid, serde.dumps(_to_wire(value)))
+        except (ConnectionError, OSError) as e:
+            self._fail_peer(to, f"send failed: {type(e).__name__}: {e}")
+            raise MpcDisconnectError(
+                f"stream to {to} died mid-send ({e})",
+                party=self.party_id, peer=to, sid=sid,
+            ) from None
 
-    async def recv_from(self, frm: int, sid: int = 0) -> Any:
+    async def _recv_impl(self, frm: int, sid: int) -> Any:
         q = self._queues.get((frm, sid))
         if q is None:
             raise MpcNetError(
-                f"party {self.party_id} has no connection to {frm} (star)"
+                f"party {self.party_id} has no connection to {frm} (star)",
+                party=self.party_id, peer=frm, sid=sid,
             )
         if frm in self._dead and q.empty():
-            raise MpcNetError(f"stream from {frm} died")
+            raise MpcDisconnectError(
+                f"stream from {frm} died "
+                f"({self._death_reason.get(frm, '?')})",
+                party=self.party_id, peer=frm, sid=sid,
+            )
         ptype, payload = await q.get()
         if ptype != DATA:
             # keep the queue poisoned: every later recv must also fail,
             # not hang on an empty queue with a dead pump
             q.put_nowait((ptype, payload))
-            raise MpcNetError(f"stream from {frm} died")
+            raise MpcDisconnectError(
+                f"stream from {frm} died ({payload})",
+                party=self.party_id, peer=frm, sid=sid,
+            )
         return _from_wire(serde.loads(payload))
+
+    async def abort(self, reason: str) -> None:
+        """Tell every live peer this party is giving up (ERR frame), then
+        close — peers fail their pending recvs immediately with the reason
+        instead of waiting out their deadlines."""
+        for peer, io in self._ios.items():
+            if peer not in self._dead:
+                await self._send_err(
+                    io, f"party {self.party_id} aborted: {reason}"
+                )
+        await self.close()
 
     async def close(self) -> None:
         if self._closed:
             return
         self._closed = True
-        for t in self._pumps:
+        for t in self._pumps + self._heartbeats:
             t.cancel()
         for io in self._ios.values():
             await io.close()

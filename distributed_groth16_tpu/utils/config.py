@@ -9,7 +9,64 @@ network-address/4|8: one `host:port` per rank.
 from __future__ import annotations
 
 import argparse
+import os
 from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class NetConfig:
+    """Fault-tolerance knobs for the star transport (parallel/net.py,
+    parallel/prodnet.py). Every field has an env override so deployed ranks
+    can be tuned without touching launcher plumbing; per-op `timeout=`
+    arguments on the collectives override the config value again.
+
+    Semantics (see docs/ROBUSTNESS.md):
+      * op_timeout_s — deadline for one point-to-point send/recv inside a
+        collective. <= 0 disables the deadline (the pre-fault-tolerance
+        behavior). Long MPC compute phases legitimately stall the wire for
+        minutes, so the default is generous; liveness between ops is the
+        heartbeat's job, not this deadline's.
+      * connect_timeout_s — TOTAL budget for bring-up: a client's dial-
+        with-backoff to the king, the king's wait for all clients, and the
+        Syn/SynAck barrier each run under it.
+      * connect_base_delay_s / connect_max_delay_s / connect_jitter —
+        exponential-backoff schedule for client re-dials: sleep
+        min(base * 2^attempt, max) * (1 + jitter * U[0,1)).
+      * heartbeat_interval_s — idle-link keepalive frame period. <= 0
+        disables heartbeats AND idle detection.
+      * idle_timeout_s — a peer silent (no frames, including heartbeats)
+        for this long is declared dead and all pending recvs from it fail.
+        CAVEAT: a rank's heartbeat task shares its asyncio loop with the
+        prover's synchronous JAX calls, so a long compute phase blocks
+        its own heartbeats — size idle_timeout_s ABOVE the longest
+        synchronous compute phase of the workload (hence the generous
+        default, matching op_timeout_s), and well above
+        heartbeat_interval_s. <= 0 disables idle detection only.
+    """
+
+    op_timeout_s: float = 600.0
+    connect_timeout_s: float = 120.0
+    connect_base_delay_s: float = 0.1
+    connect_max_delay_s: float = 5.0
+    connect_jitter: float = 0.5
+    heartbeat_interval_s: float = 15.0
+    idle_timeout_s: float = 600.0
+
+    @staticmethod
+    def from_env() -> "NetConfig":
+        def f(name: str, default: float) -> float:
+            v = os.environ.get(name)
+            return float(v) if v not in (None, "") else default
+
+        return NetConfig(
+            op_timeout_s=f("DG16_NET_OP_TIMEOUT_S", 600.0),
+            connect_timeout_s=f("DG16_NET_CONNECT_TIMEOUT_S", 120.0),
+            connect_base_delay_s=f("DG16_NET_CONNECT_BASE_DELAY_S", 0.1),
+            connect_max_delay_s=f("DG16_NET_CONNECT_MAX_DELAY_S", 5.0),
+            connect_jitter=f("DG16_NET_CONNECT_JITTER", 0.5),
+            heartbeat_interval_s=f("DG16_NET_HEARTBEAT_S", 15.0),
+            idle_timeout_s=f("DG16_NET_IDLE_TIMEOUT_S", 600.0),
+        )
 
 
 @dataclass

@@ -7,9 +7,10 @@ values crossing a real transport are pytrees of uint32 limb tensors
 little-endian array buffers. Pickle-free: the transport may span trust
 domains.
 
-Format: u8 tag per node — 0 none, 1 array, 2 list, 3 tuple, 4 int —
-arrays as (dtype_code u8, ndim u8, dims u32*, raw bytes), lists/tuples as
-(count u32, children), ints as i64.
+Format: u8 tag per node — 0 none, 1 array, 2 list, 3 tuple, 4 int,
+5 str — arrays as (dtype_code u8, ndim u8, dims u32*, raw bytes),
+lists/tuples as (count u32, children), ints as i64, strs as
+(byte-count u32, utf-8 bytes; the ERR-frame payload of prodnet.py).
 """
 
 from __future__ import annotations
@@ -39,6 +40,11 @@ def _enc(v, out: bytearray) -> None:
     elif isinstance(v, (int, np.integer)):
         out.append(4)
         out += struct.pack("<q", int(v))
+    elif isinstance(v, str):
+        b = v.encode("utf-8")
+        out.append(5)
+        out += struct.pack("<I", len(b))
+        out += b
     else:
         arr = np.asarray(v)
         code = _DTYPE_CODES.get(arr.dtype)
@@ -74,6 +80,13 @@ def _dec(data: bytes, pos: int):
     if tag == 4:
         (x,) = struct.unpack_from("<q", data, pos)
         return x, pos + 8
+    if tag == 5:
+        (n,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        if pos + n > len(data):
+            # slicing would silently truncate; fail like every other tag
+            raise ValueError("truncated wire string")
+        return data[pos : pos + n].decode("utf-8"), pos + n
     if tag == 1:
         code, ndim = data[pos], data[pos + 1]
         pos += 2

@@ -21,10 +21,6 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from distributed_groth16_tpu.parallel.prodnet import ProdNet
-from distributed_groth16_tpu.utils.certs import (
-    king_ssl_context,
-    peer_ssl_context,
-)
 from distributed_groth16_tpu.utils.config import read_address_file
 
 
@@ -36,6 +32,12 @@ async def run(opt) -> int:
     if opt.plain:
         king_ctx = peer_ctx = None
     else:
+        # lazy: --plain must not require the TLS dependency (cryptography)
+        from distributed_groth16_tpu.utils.certs import (
+            king_ssl_context,
+            peer_ssl_context,
+        )
+
         cert = lambda i: os.path.join(opt.certs, f"{i}.cert.pem")  # noqa: E731
         key = lambda i: os.path.join(opt.certs, f"{i}.key.pem")  # noqa: E731
         if opt.id == 0:

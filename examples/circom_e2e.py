@@ -86,7 +86,7 @@ def main() -> int:
     # 8-party MPC prove over packed shares (the dsha256 template)
     pp = PackedSharingParams(args.l)
     qap_shares = comp.qap(z_mont).pss(pp)
-    crs = pack_proving_key(pk, pp)
+    crs = pack_proving_key(pk, pp, strip=True)
     ni = r1cs.num_instance
     a_sh = pack_from_witness(pp, z_mont[1:])
     ax_sh = pack_from_witness(pp, z_mont[ni:])

@@ -14,6 +14,15 @@ test.rs's own sha256 fixture lacks a compiled .r1cs). Stats are byte
 sizes of the device tensors rather than Rust mem::size_of, which is the
 meaningful equivalent here.
 
+Vector discovery: the artifact directory comes from $DG16_VECTORS
+(default: the historical /root/reference/ark-circom/test-vectors). When
+the artifacts are absent the example does NOT silently pass: it falls
+back to the in-repo fixture — the same c <== a*b multiplier circuit
+built natively (frontend/r1cs.py) — and runs the identical
+introspect/prove/verify ladder, so a CI lane without the external repo
+still proves and verifies. Set DG16_REQUIRE_VECTORS=1 to fail (exit 3)
+instead of falling back.
+
 Run: python examples/introspect.py [--a 3] [--b 11]
 """
 
@@ -27,7 +36,9 @@ import time
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
-VECTORS = "/root/reference/ark-circom/test-vectors"
+VECTORS = os.environ.get(
+    "DG16_VECTORS", "/root/reference/ark-circom/test-vectors"
+)
 
 if os.environ.get("DG16_EXAMPLE_TPU") != "1":
     # same dance as tests/conftest.py: the experimental TPU plugin hooks
@@ -45,43 +56,83 @@ def _nbytes(x) -> int:
     return np.asarray(x).nbytes
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--a", type=int, default=3)
-    ap.add_argument("--b", type=int, default=11)
-    args = ap.parse_args()
-
+def _circom_circuits(args):
+    """(r1cs, full_assignment, setup_only_r1cs) from the external circom
+    artifacts — the test.rs builder/builder2 pair."""
     from distributed_groth16_tpu.frontend.builder import (
         CircomBuilder,
         CircomConfig,
     )
-    from distributed_groth16_tpu.models.groth16 import setup, verify
-    from distributed_groth16_tpu.models.groth16.prove import prove_single
-    from distributed_groth16_tpu.models.groth16.qap import CompiledR1CS
-    from distributed_groth16_tpu.ops.field import fr
 
     wasm = f"{VECTORS}/mycircuit.wasm"
     r1cs_path = f"{VECTORS}/mycircuit.r1cs"
-    if not (os.path.exists(wasm) and os.path.exists(r1cs_path)):
-        print("fixture artifacts not found; nothing to introspect")
-        return 0
-
-    cwd = os.getcwd()
-    print(f"Current working directory: {cwd}")
-
     cfg = CircomConfig(wasm, r1cs_path, sanity_check=True)
     builder = CircomBuilder(cfg)
     builder.push_input("a", args.a)
     builder.push_input("b", args.b)
     circuit = builder.build()
-    full_assignment = circuit.witness
-    r1cs = circuit.r1cs
 
     # second, setup-only circuit from the same config (test.rs builder2:
     # no inputs pushed, no witness computed)
     builder2 = CircomBuilder(cfg)
     circuit2 = builder2.setup()
     assert circuit2.witness is None
+    return circuit.r1cs, circuit.witness, circuit2.r1cs
+
+
+def _fixture_circuits(args):
+    """The in-repo fallback fixture: mycircuit's c <== a*b multiplier,
+    built natively with the ConstraintSystem API — same instance/witness
+    shape as the circom artifact, no external files needed."""
+    from distributed_groth16_tpu.frontend.r1cs import ConstraintSystem
+    from distributed_groth16_tpu.ops.constants import R
+
+    def build():
+        cs = ConstraintSystem()
+        c = cs.new_instance(args.a * args.b % R)
+        aw = cs.new_witness(args.a)
+        bw = cs.new_witness(args.b)
+        cs.enforce([(1, aw)], [(1, bw)], [(1, c)])
+        return cs.finish()
+
+    r1cs, z = build()
+    r1cs2, _ = build()  # the setup-only twin
+    return r1cs, z, r1cs2
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--a", type=int, default=3)
+    ap.add_argument("--b", type=int, default=11)
+    args = ap.parse_args()
+
+    from distributed_groth16_tpu.models.groth16 import setup, verify
+    from distributed_groth16_tpu.models.groth16.prove import prove_single
+    from distributed_groth16_tpu.models.groth16.qap import CompiledR1CS
+    from distributed_groth16_tpu.ops.field import fr
+
+    have_vectors = os.path.exists(f"{VECTORS}/mycircuit.wasm") and (
+        os.path.exists(f"{VECTORS}/mycircuit.r1cs")
+    )
+    if not have_vectors and os.environ.get("DG16_REQUIRE_VECTORS") == "1":
+        print(
+            f"introspect: FAIL — circom artifacts not found under "
+            f"{VECTORS} and DG16_REQUIRE_VECTORS=1 (set DG16_VECTORS to "
+            f"the ark-circom test-vectors directory)",
+            file=sys.stderr,
+        )
+        return 3
+
+    print(f"Current working directory: {os.getcwd()}")
+    if have_vectors:
+        print(f"using circom artifacts from {VECTORS}")
+        r1cs, full_assignment, r1cs2 = _circom_circuits(args)
+    else:
+        print(
+            f"circom artifacts not found under {VECTORS}; using the "
+            f"in-repo multiplier fixture (set DG16_VECTORS to override)"
+        )
+        r1cs, full_assignment, r1cs2 = _fixture_circuits(args)
 
     pk = setup(r1cs, seed=42)
 
@@ -102,8 +153,8 @@ def main() -> int:
     print(f"Full assignment len: {len(full_assignment)}")
     print(f"Number of inputs: {r1cs.num_instance}")
     print(f"Number of constraints: {r1cs.num_constraints}")
-    print(f"Number of inputs2: {circuit2.r1cs.num_instance}")
-    print(f"Number of constraints2: {circuit2.r1cs.num_constraints}")
+    print(f"Number of inputs2: {r1cs2.num_instance}")
+    print(f"Number of constraints2: {r1cs2.num_constraints}")
 
     # -- proof without MPC, r = s = 0 (test.rs:211-231) --------------------
     comp = CompiledR1CS(r1cs)

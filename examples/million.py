@@ -24,6 +24,10 @@ def main() -> int:
     p.add_argument("--x0", type=int, default=999992)
     p.add_argument("--skip-mpc", action="store_true",
                    help="setup + single-node prove only (CPU-feasible at 2^20)")
+    p.add_argument("--round-retries", type=int, default=2,
+                   help="re-run the MPC round up to this many times on a "
+                        "transient transport fault (MpcNetError) instead "
+                        "of losing the whole proof")
     args = p.parse_args()
 
     from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
@@ -37,8 +41,9 @@ def main() -> int:
         verify,
     )
     from distributed_groth16_tpu.ops.field import fr
-    from distributed_groth16_tpu.parallel.net import simulate_network_round
+    from distributed_groth16_tpu.parallel.net import run_round_with_retries
     from distributed_groth16_tpu.parallel.pss import PackedSharingParams
+    from distributed_groth16_tpu.utils.config import NetConfig
     from distributed_groth16_tpu.utils.timers import PhaseTimings, phase
 
     timings = PhaseTimings()
@@ -73,21 +78,41 @@ def main() -> int:
 
     with phase("packing", timings):
         qap_shares = comp.qap(z_mont).pss(pp)
-        crs_shares = pack_proving_key(pk, pp)
+        # strip=True: the dealer's trapdoor-derived query scalars are
+        # destroyed the moment the shares exist (keys.py hazard note)
+        crs_shares = pack_proving_key(pk, pp, strip=True)
         a_sh = pack_from_witness(pp, z_mont[1:])
         ax_sh = pack_from_witness(pp, z_mont[r1cs.num_instance:])
 
     async def party(net, d):
         return await distributed_prove_party(pp, d[0], d[1], d[2], d[3], net)
 
+    # In-process round: all parties share ONE event loop, so a long
+    # synchronous compute phase blocks every timer and an op deadline can
+    # false-fire on loop resume even though the data arrived. Deadlines
+    # also can't detect a dead peer here (there is no peer process) —
+    # default them off unless explicitly configured.
+    net_cfg = NetConfig.from_env()
+    if "DG16_NET_OP_TIMEOUT_S" not in os.environ:
+        from dataclasses import replace as _dc_replace
+
+        net_cfg = _dc_replace(net_cfg, op_timeout_s=0.0)
+
     with phase("MPC Proof", timings):
-        res = simulate_network_round(
+        # a transient transport fault (timeout, dead link) re-runs the
+        # round on a fresh fabric instead of killing a multi-hour proof
+        res = run_round_with_retries(
             pp.n,
             party,
             [
                 (crs_shares[i], qap_shares[i], a_sh[i], ax_sh[i])
                 for i in range(pp.n)
             ],
+            retries=args.round_retries,
+            net_cfg=net_cfg,
+            on_retry=lambda a, e: print(
+                f"MPC round attempt {a + 1} failed ({e}); retrying"
+            ),
         )
     proof = reassemble_proof(res[0], pk)
     ok = verify(pk.vk, proof, z[1 : r1cs.num_instance])

@@ -114,7 +114,7 @@ async def run(opt) -> int:
         z_mont = F.encode(z)
         comp = CompiledR1CS(r1cs)
         qap_share = comp.qap(z_mont).pss(pp)[opt.id]
-        crs_share = pack_proving_key(pk, pp)[opt.id]
+        crs_share = pack_proving_key(pk, pp, strip=True)[opt.id]
         a_share = pack_from_witness(pp, z_mont[1:])[opt.id]
         ax_share = pack_from_witness(pp, z_mont[r1cs.num_instance:])[opt.id]
 

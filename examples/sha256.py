@@ -80,7 +80,7 @@ def main() -> int:
         pp = PackedSharingParams(args.l)
         with phase("packing", timings):
             qap_shares = comp.qap(z_mont).pss(pp)
-            crs_shares = pack_proving_key(pk, pp)
+            crs_shares = pack_proving_key(pk, pp, strip=True)
             a_sh = pack_from_witness(pp, z_mont[1:])
             ax_sh = pack_from_witness(pp, z_mont[r1cs.num_instance:])
 

@@ -6,7 +6,10 @@
 #
 # Caller sets: EXAMPLE (python file), EXTRA_ARGS (array, per-rank args
 # appended after --id/--input/--certs/--n). Honors N, PORT, PLAIN,
-# WORK_DIR, NL_PLATFORM like nonlocal_sha256.sh.
+# WORK_DIR, NL_PLATFORM like nonlocal_sha256.sh, plus ROUND_RETRIES
+# (default 1): a failed round — any rank exiting non-zero, e.g. on a
+# transient MpcNetError — relaunches ALL ranks up to that many extra
+# times before the harness reports failure.
 
 set -euo pipefail
 
@@ -33,22 +36,33 @@ done
 # the axon TPU plugin can hang backend init when PALLAS_AXON_POOL_IPS is
 # set; ranks run on the CPU backend unless NL_PLATFORM overrides
 unset PALLAS_AXON_POOL_IPS
-PIDS=()
-for i in $(seq $((N - 1)) -1 0); do
-  JAX_PLATFORMS=${NL_PLATFORM:-cpu} python "$EXAMPLE" \
-    --id "$i" --input "$ADDR" --certs "$WORK/certs" --n "$N" \
-    "${EXTRA_ARGS[@]}" "${TLS_ARGS[@]}" \
-    > "$WORK/rank$i.log" 2>&1 &
-  PIDS+=($!)
+
+ROUND_RETRIES=${ROUND_RETRIES:-1}
+ATTEMPT=0
+while :; do
+  PIDS=()
+  for i in $(seq $((N - 1)) -1 0); do
+    JAX_PLATFORMS=${NL_PLATFORM:-cpu} python "$EXAMPLE" \
+      --id "$i" --input "$ADDR" --certs "$WORK/certs" --n "$N" \
+      "${EXTRA_ARGS[@]}" "${TLS_ARGS[@]}" \
+      > "$WORK/rank$i.log" 2>&1 &
+    PIDS+=($!)
+  done
+
+  STATUS=0
+  for pid in "${PIDS[@]}"; do
+    wait "$pid" || STATUS=1
+  done
+  if [ "$STATUS" -eq 0 ] || [ "$ATTEMPT" -ge "$ROUND_RETRIES" ]; then
+    break
+  fi
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "$(basename "$EXAMPLE"): round failed; retry $ATTEMPT/$ROUND_RETRIES"
 done
 
-STATUS=0
-for pid in "${PIDS[@]}"; do
-  wait "$pid" || STATUS=1
-done
 grep -h "rank 0:" "$WORK"/rank*.log || true
 if [ "$STATUS" -ne 0 ]; then
-  echo "$(basename "$EXAMPLE"): FAILED — logs:"
+  echo "$(basename "$EXAMPLE"): FAILED after $((ATTEMPT + 1)) attempt(s) — logs:"
   tail -n 20 "$WORK"/rank*.log
   exit 1
 fi

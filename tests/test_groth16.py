@@ -171,3 +171,23 @@ def test_scalar_route_pack_matches_point_route(world):
             a = curve.decode(getattr(f, name))
             b = curve.decode(getattr(s, name))
             assert list(a) == list(b), f"query {name} diverged"
+
+
+def test_strip_clears_trapdoor_scalars(world):
+    # strip() (and pack_proving_key(strip=True)) must destroy the
+    # trapdoor-derived query scalars — keeping them alive on a pk object
+    # that crosses a trust boundary breaks the CRS soundness assumption
+    # (keys.py hazard note). Work on a shallow copy so the shared module
+    # fixture keeps its scalars for other tests.
+    from dataclasses import replace
+
+    pk = replace(world["pk"])
+    pp = world["pp"]
+    assert pk.query_scalars is not None
+    shares = pack_proving_key(pk, pp, strip=True)
+    assert pk.query_scalars is None, "strip=True must clear the scalars"
+    assert world["pk"].query_scalars is not None  # the fixture is untouched
+    # a stripped key still packs — now via the in-exponent point route
+    again = pack_proving_key(pk, pp)
+    assert len(again) == len(shares) == pp.n
+    assert pk.strip() is pk  # idempotent, chains

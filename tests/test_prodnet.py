@@ -33,7 +33,15 @@ def test_serde_roundtrip():
         [1, 2, 3],
         (np.arange(12, dtype=np.uint32).reshape(3, 4), None),
         [np.zeros((2, 16), np.uint32), (5, np.ones(3, np.int64))],
+        "an ERR-frame reason: party 3 died (idle timeout)",
+        ["mixed", (1, "nested"), None],
     ]
+    assert serde.loads(serde.dumps("")) == ""
+    assert serde.loads(serde.dumps("ünïcôde ✓")) == "ünïcôde ✓"
+    # a truncated string payload must raise, not silently shorten
+    blob = bytearray(serde.dumps("a reason string"))
+    with pytest.raises(ValueError):
+        serde.loads(bytes(blob[:-3]))
     for v in cases:
         back = serde.loads(serde.dumps(v))
         if isinstance(v, (list, tuple)):
@@ -229,5 +237,59 @@ def test_frame_length_cap():
         await _send_frame(a, 2, 3, b"payload")
         typ, sid, payload = await _recv_frame(b)
         assert (typ, sid, payload) == (2, 3, b"payload")
+
+    asyncio.run(run())
+
+
+def test_frame_length_boundaries(monkeypatch):
+    """Exact boundary semantics of the frame cap (satellite coverage for
+    _send_frame/_recv_frame): the cap includes the 2-byte envelope, a
+    frame AT the cap passes, one past it is refused on both sides, and an
+    undersized length (< envelope) is rejected as corrupt. The cap is
+    monkeypatched small so the boundary is testable without 256 MiB
+    allocations (both helpers read the module global at call time)."""
+    import struct
+
+    from distributed_groth16_tpu.parallel import prodnet
+
+    cap = 64
+    monkeypatch.setattr(prodnet, "MAX_FRAME_LEN", cap)
+
+    async def run():
+        a, b = ChannelIO.pair()
+        # exactly at the cap: payload + 2-byte envelope == cap
+        await prodnet._send_frame(a, 2, 1, b"p" * (cap - 2))
+        typ, sid, payload = await prodnet._recv_frame(b)
+        assert (typ, sid, payload) == (2, 1, b"p" * (cap - 2))
+        # one byte over: refused locally before any bytes hit the wire
+        with pytest.raises(ValueError):
+            await prodnet._send_frame(a, 2, 1, b"p" * (cap - 1))
+        # one byte over, claimed by a hostile header: refused on read
+        await a.write(struct.pack("!I", cap + 1))
+        with pytest.raises(ConnectionError):
+            await prodnet._recv_frame(b)
+
+    asyncio.run(run())
+
+
+def test_undersized_and_truncated_frames_rejected():
+    import struct
+
+    from distributed_groth16_tpu.parallel.prodnet import _recv_frame
+
+    async def run():
+        # length 0 and 1 cannot even hold the (packet_type, sid) envelope
+        for bad_len in (0, 1):
+            a, b = ChannelIO.pair()
+            await a.write(struct.pack("!I", bad_len))
+            with pytest.raises(ConnectionError):
+                await _recv_frame(b)
+        # a header promising more bytes than ever arrive (peer dies
+        # mid-frame): the read fails on EOF instead of hanging
+        a, b = ChannelIO.pair()
+        await a.write(struct.pack("!I", 10) + b"abc")
+        await a.close()
+        with pytest.raises(ConnectionResetError):
+            await _recv_frame(b)
 
     asyncio.run(run())
