@@ -9,6 +9,7 @@ Layer map (mirrors SURVEY.md §1):
     parallel/  net collectives, PSS, d_fft/d_msm/d_pp  (the "mpc-net"+"dist-primitives" role)
     models/    groth16 prover/setup/verifier           (the "groth16" crate role)
     frontend/  circom r1cs/zkey/wtns readers, witness  (the "ark-circom" role)
+    service/   proof-job queue, worker pool, CRS cache (docs/SERVICE.md)
     api/, cli  HTTP proving service + client           (the "mpc-api"/"zk-cli" role)
 """
 

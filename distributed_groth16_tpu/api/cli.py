@@ -15,6 +15,14 @@ Usage:
       --witness w.wtns [--l 2]
   python -m distributed_groth16_tpu.api.cli verify --circuit-id ID \
       --proof proof.bin --public 33 [--public ...]
+  python -m distributed_groth16_tpu.api.cli job submit --circuit-id ID \
+      --witness w.wtns [--mpc] [--l 2]
+  python -m distributed_groth16_tpu.api.cli job status --job-id JOB
+  python -m distributed_groth16_tpu.api.cli job watch --job-id JOB \
+      [--interval 2] [--out proof.bin]
+
+Queue-full submissions (HTTP 429) exit with the server's retryAfter hint
+(docs/SERVICE.md describes the backpressure semantics).
 """
 
 from __future__ import annotations
@@ -33,7 +41,15 @@ def _body(resp) -> dict:
         raise SystemExit(
             f"server error: HTTP {resp.status_code} — {resp.text[:300]}"
         )
-    if resp.status_code != 200:
+    if resp.status_code == 429:
+        # queue-full backpressure (docs/SERVICE.md): surface the server's
+        # retryAfter hint instead of a generic error
+        hint = body.get("retryAfter")
+        raise SystemExit(
+            f"server busy: {body.get('error', 'job queue full')}"
+            + (f" — retry after {hint}s" if hint is not None else "")
+        )
+    if resp.status_code not in (200, 202):
         raise SystemExit(f"server error: {body.get('error', body)}")
     return body
 
@@ -90,6 +106,50 @@ def cmd_verify(args) -> dict:
     )
 
 
+def cmd_job_submit(args) -> dict:
+    """POST /jobs/prove — returns {jobId, state} immediately; pair with
+    `job watch` to follow it to completion."""
+    fields = {
+        "circuit_id": args.circuit_id.encode(),
+        "witness_file": open(args.witness, "rb").read(),
+    }
+    if args.mpc:
+        fields["mpc"] = b"1"
+        fields["l"] = str(args.l).encode()
+    return _post_multipart(f"{args.url}/jobs/prove", fields)
+
+
+def _job_status(url: str, job_id: str) -> dict:
+    return _body(requests.get(f"{url}/jobs/{job_id}", timeout=60))
+
+
+def cmd_job_status(args) -> dict:
+    return _job_status(args.url, args.job_id)
+
+
+def cmd_job_watch(args) -> dict:
+    """Poll GET /jobs/{id} until the job is terminal; on DONE, fetch the
+    result (optionally writing the proof bytes to --out)."""
+    import time
+
+    while True:
+        body = _job_status(args.url, args.job_id)
+        state = body.get("state")
+        print(f"{args.job_id}: {state}", file=sys.stderr, flush=True)
+        if state in ("DONE", "FAILED", "CANCELLED"):
+            break
+        time.sleep(args.interval)
+    if state != "DONE":
+        return body
+    result = _body(
+        requests.get(f"{args.url}/jobs/{args.job_id}/result", timeout=600)
+    )
+    if args.out:
+        with open(args.out, "wb") as f:
+            f.write(bytes(result["proof"]))
+    return result
+
+
 def cmd_export_eth(args) -> dict:
     """Local conversion — no server round-trip needed."""
     from ..frontend.ark_serde import proof_from_bytes
@@ -123,6 +183,28 @@ def main(argv=None) -> None:
         sp.add_argument("--out", default=None, help="write proof bytes here")
         sp.add_argument("--l", type=int, default=2)
         sp.set_defaults(fn=fn)
+
+    jp = sub.add_parser(
+        "job", help="async jobs API: submit / status / watch (docs/SERVICE.md)"
+    )
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+
+    sp = jsub.add_parser("submit")
+    sp.add_argument("--circuit-id", required=True)
+    sp.add_argument("--witness", required=True, help=".wtns file")
+    sp.add_argument("--mpc", action="store_true", help="packed-MPC proof")
+    sp.add_argument("--l", type=int, default=2)
+    sp.set_defaults(fn=cmd_job_submit)
+
+    sp = jsub.add_parser("status")
+    sp.add_argument("--job-id", required=True)
+    sp.set_defaults(fn=cmd_job_status)
+
+    sp = jsub.add_parser("watch")
+    sp.add_argument("--job-id", required=True)
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--out", default=None, help="write proof bytes here")
+    sp.set_defaults(fn=cmd_job_watch)
 
     sp = sub.add_parser("verify")
     sp.add_argument("--circuit-id", required=True)

@@ -1,27 +1,36 @@
-"""HTTP proving service — the mpc-api role (mpc-api/src/main.rs:795-805).
+"""HTTP proving service — the mpc-api role (mpc-api/src/main.rs:795-805),
+now fronting the proof-job service layer (service/, docs/SERVICE.md).
 
-Routes and DTO field names mirror the reference exactly:
+Legacy routes and DTO field names mirror the reference exactly:
 
   POST /save_circuit                multipart: circuit_name, r1cs_file,
                                     witness_generator
   POST /create_proof_without_mpc    multipart: circuit_id, input_file |
                                     witness_file (.wtns)
-  POST /create_proof_with_naive_mpc same fields; spins an in-process
-                                    LocalSimNet of pp.n parties inside the
-                                    handler (main.rs:560-596 — "naive" MPC)
+  POST /create_proof_with_naive_mpc same fields (+ l)
   POST /verify_proof                JSON: circuitId, proof (bytes),
                                     publicInputs ([str])
   GET  /get_circuit_files/{id}
 
-Responses use the reference's camelCase DTO shapes (common/src/dto/mod.rs):
-circuitId / circuitName / proof / isValid / timeTaken / remarks; errors are
-HTTP 500 {"error": ...} (CustomError semantics). Proofs travel as
-ark-style 128-byte compressed blobs (frontend/ark_serde.py), JSON-encoded
-as byte lists.
+Jobs API (the async path — every proof, including the legacy synchronous
+routes above, funnels through one queue + bounded worker pool):
 
-Witness generation from JSON `input_file` runs the circuit's circom WASM
-on the pure-Python interpreter (frontend/wasm_vm.py); a precomputed snarkjs
-`.wtns` may alternatively be uploaded in the `witness_file` field.
+  POST   /jobs/prove      same multipart fields + optional `mpc` flag;
+                          returns {jobId, state} immediately
+  GET    /jobs/{id}       status DTO (state, timestamps, phases, error)
+  GET    /jobs/{id}/result  proof DTO once DONE (409 while in flight)
+  DELETE /jobs/{id}       cancel (QUEUED never runs; RUNNING cancels
+                          cooperatively at the next phase boundary)
+  GET    /healthz         liveness + pool shape
+  GET    /stats           queue depth/counters, CRS-cache hit rate,
+                          per-phase timing aggregates
+
+Backpressure: submissions past the queue bound get HTTP 429 with a
+`retryAfter` hint (seconds). Sync responses keep the reference's camelCase
+DTO shapes (common/src/dto/mod.rs): circuitId / circuitName / proof /
+isValid / timeTaken / remarks; errors are HTTP 500 {"error": ...}
+(CustomError semantics). Proofs travel as ark-style 128-byte compressed
+blobs (frontend/ark_serde.py), JSON-encoded as byte lists.
 """
 
 from __future__ import annotations
@@ -32,28 +41,40 @@ import time
 
 from aiohttp import web
 
-from ..frontend.ark_serde import proof_from_bytes, proof_to_bytes
-from ..frontend.readers import read_wtns
-from ..models.groth16 import (
-    CompiledR1CS,
-    distributed_prove_party,
-    pack_from_witness,
-    pack_proving_key,
-    reassemble_proof,
-    verify,
+from ..frontend.ark_serde import proof_from_bytes
+from ..models.groth16 import verify
+from ..service import (
+    CrsCache,
+    JobQueue,
+    JobState,
+    ProofExecutor,
+    ProofJob,
+    QueueFullError,
+    WorkerPool,
 )
-from ..models.groth16.prove import prove_single
-from ..ops.field import fr
-from ..parallel.net import simulate_network_round
-from ..parallel.pss import PackedSharingParams
-from ..utils.timers import PhaseTimings, phase
+from ..utils.config import ServiceConfig
 from .store import CircuitStore
 
 MAX_BODY = 100 * 1024 * 1024  # 100 MB limit (main.rs:801)
 
+_JOB_FIELDS = ("witness_file", "input_file")
 
-def _error(msg: str) -> web.Response:
-    return web.json_response({"error": msg}, status=500)
+
+def _error(msg: str, status: int = 500) -> web.Response:
+    return web.json_response({"error": msg}, status=status)
+
+
+def _busy(e: QueueFullError) -> web.Response:
+    return web.json_response(
+        {
+            "error": str(e),
+            "retryAfter": round(e.retry_after_s, 1),
+            "queueDepth": e.depth,
+            "queueBound": e.bound,
+        },
+        status=429,
+        headers={"Retry-After": str(int(e.retry_after_s) or 1)},
+    )
 
 
 async def _read_multipart(request) -> dict[str, bytes]:
@@ -69,10 +90,47 @@ def _millis(t0: float) -> int:
 
 
 class ApiServer:
-    def __init__(self, store: CircuitStore | None = None):
+    def __init__(
+        self,
+        store: CircuitStore | None = None,
+        cfg: ServiceConfig | None = None,
+    ):
         self.store = store or CircuitStore()
+        self.cfg = cfg or ServiceConfig.from_env()
+        self.crs_cache = CrsCache(self.cfg.crs_cache_size)
+        self.queue = JobQueue(
+            bound=self.cfg.queue_bound,
+            workers=self.cfg.workers,
+            retry_after_s=self.cfg.retry_after_s,
+            history_bound=self.cfg.job_history,
+        )
+        self.executor = ProofExecutor(self.store, self.crs_cache, self.cfg)
+        self.pool = WorkerPool(self.queue, self.executor, self.cfg.workers)
 
-    # -- handlers ------------------------------------------------------------
+    # -- job plumbing --------------------------------------------------------
+
+    def _submit(self, fields: dict[str, bytes], kind: str) -> ProofJob:
+        """Build + enqueue a ProofJob from multipart fields. Raises
+        KeyError/ValueError on malformed submissions (mapped to 500 by the
+        callers, CustomError-style) and QueueFullError past the bound."""
+        circuit_id = fields["circuit_id"].decode()
+        job = ProofJob(
+            kind=kind,
+            circuit_id=circuit_id,
+            fields={k: fields[k] for k in _JOB_FIELDS if k in fields},
+            l=int(fields.get("l", b"2").decode()),
+        )
+        return self.queue.submit(job)
+
+    async def _submit_and_await(self, request, kind: str) -> ProofJob:
+        """The legacy synchronous routes: enqueue, then block the request
+        (not the loop) until the job is terminal."""
+        fields = await _read_multipart(request)
+        job = self._submit(fields, kind)
+        await job.wait()
+        return job
+
+    # -- legacy handlers -----------------------------------------------------
 
     async def save_circuit(self, request):
         t0 = time.time()
@@ -94,55 +152,20 @@ class ApiServer:
             }
         )
 
-    def _witness_from_fields(self, fields, r1cs, circuit_id=None) -> list[int]:
-        if "witness_file" in fields:
-            z = read_wtns(fields["witness_file"])
-        elif "input_file" in fields:
-            # the reference's primary prove flow (mpc-api/src/main.rs:282-421):
-            # JSON inputs -> circom WASM witness generation (here on the
-            # pure-Python interpreter, frontend/wasm_vm.py)
-            import json
-
-            from ..frontend.witness_calculator import WitnessCalculator
-
-            _, wasm = self.store.get_files(circuit_id)
-            if not wasm:
-                raise ValueError(
-                    "circuit was saved without a witness_generator wasm; "
-                    "upload a .wtns in the witness_file field instead"
-                )
-            # WitnessCalculator flattens nested arrays and int()s string
-            # leaves itself — pass the parsed JSON through unmodified
-            inputs = json.loads(fields["input_file"].decode())
-            wc = WitnessCalculator(wasm)
-            z = wc.calculate_witness(inputs)
-        else:
-            raise ValueError("need witness_file or input_file")
-        if len(z) != r1cs.num_wires or not r1cs.is_satisfied(z):
-            raise ValueError("witness does not satisfy the circuit")
-        return z
-
     async def create_proof_without_mpc(self, request):
         t0 = time.time()
         try:
-            fields = await _read_multipart(request)
-            circuit_id = fields["circuit_id"].decode()
-            r1cs, pk = await asyncio.to_thread(self.store.load, circuit_id)
-            z = await asyncio.to_thread(
-                self._witness_from_fields, fields, r1cs, circuit_id
-            )
-
-            def run():
-                comp = CompiledR1CS(r1cs)
-                return prove_single(pk, comp, fr().encode(z))
-
-            proof = await asyncio.to_thread(run)
+            job = await self._submit_and_await(request, "prove")
+        except QueueFullError as e:
+            return _busy(e)
         except Exception as e:  # noqa: BLE001
             return _error(str(e))
+        if job.state is not JobState.DONE:
+            return _error((job.error or {}).get("error", job.state.value))
         return web.json_response(
             {
-                "circuitId": circuit_id,
-                "proof": list(proof_to_bytes(proof)),
+                "circuitId": job.circuit_id,
+                "proof": job.result["proof"],
                 "timeTaken": _millis(t0),
             }
         )
@@ -150,52 +173,19 @@ class ApiServer:
     async def create_proof_with_naive_mpc(self, request):
         t0 = time.time()
         try:
-            fields = await _read_multipart(request)
-            circuit_id = fields["circuit_id"].decode()
-            l = int(fields.get("l", b"2").decode())
-            r1cs, pk = await asyncio.to_thread(self.store.load, circuit_id)
-            z = await asyncio.to_thread(
-                self._witness_from_fields, fields, r1cs, circuit_id
-            )
-
-            def run():
-                timings = PhaseTimings()
-                pp = PackedSharingParams(l)
-                F = fr()
-                z_mont = F.encode(z)
-                with phase("packing", timings):
-                    comp = CompiledR1CS(r1cs)
-                    qap_shares = comp.qap(z_mont).pss(pp)
-                    crs_shares = pack_proving_key(pk, pp, strip=True)
-                    ni = r1cs.num_instance
-                    a_sh = pack_from_witness(pp, z_mont[1:])
-                    ax_sh = pack_from_witness(pp, z_mont[ni:])
-
-                async def party(net, d):
-                    return await distributed_prove_party(
-                        pp, d[0], d[1], d[2], d[3], net
-                    )
-
-                with phase("MPC Proof", timings):
-                    res = simulate_network_round(
-                        pp.n,
-                        party,
-                        [
-                            (crs_shares[i], qap_shares[i], a_sh[i], ax_sh[i])
-                            for i in range(pp.n)
-                        ],
-                    )
-                return reassemble_proof(res[0], pk), timings
-
-            proof, timings = await asyncio.to_thread(run)
+            job = await self._submit_and_await(request, "mpc_prove")
+        except QueueFullError as e:
+            return _busy(e)
         except Exception as e:  # noqa: BLE001
             return _error(str(e))
+        if job.state is not JobState.DONE:
+            return _error((job.error or {}).get("error", job.state.value))
         return web.json_response(
             {
-                "circuitId": circuit_id,
-                "proof": list(proof_to_bytes(proof)),
+                "circuitId": job.circuit_id,
+                "proof": job.result["proof"],
                 "timeTaken": _millis(t0),
-                "phases": timings.as_millis(),
+                "phases": job.result["phases"],
             }
         )
 
@@ -239,10 +229,104 @@ class ApiServer:
             }
         )
 
+    # -- jobs API ------------------------------------------------------------
+
+    async def jobs_prove(self, request):
+        try:
+            fields = await _read_multipart(request)
+            mpc = fields.get("mpc", b"").decode().lower() in ("1", "true", "yes")
+            job = self._submit(fields, "mpc_prove" if mpc else "prove")
+        except QueueFullError as e:
+            return _busy(e)
+        except Exception as e:  # noqa: BLE001
+            return _error(str(e))
+        return web.json_response(
+            {
+                "jobId": job.id,
+                "circuitId": job.circuit_id,
+                "state": job.state.value,
+                "queueDepth": self.queue.stats()["queueDepth"],
+            },
+            status=202,
+        )
+
+    def _job_or_404(self, request) -> ProofJob | web.Response:
+        job = self.queue.jobs.get(request.match_info["job_id"])
+        if job is None:
+            return _error("unknown job id", status=404)
+        return job
+
+    async def job_status(self, request):
+        job = self._job_or_404(request)
+        if isinstance(job, web.Response):
+            return job
+        return web.json_response(job.to_dict())
+
+    async def job_result(self, request):
+        job = self._job_or_404(request)
+        if isinstance(job, web.Response):
+            return job
+        if job.state is JobState.FAILED:
+            return _error((job.error or {}).get("error", "job failed"))
+        if job.state is JobState.CANCELLED:
+            return _error("job was cancelled", status=410)
+        if job.state is not JobState.DONE:
+            return _error(f"job not finished (state {job.state.value})", 409)
+        rt = job.runtime_s or 0.0
+        return web.json_response(
+            {
+                "jobId": job.id,
+                "circuitId": job.circuit_id,
+                "proof": job.result["proof"],
+                "phases": job.result["phases"],
+                "timeTaken": int(rt * 1000),
+                "remarks": None,
+            }
+        )
+
+    async def job_cancel(self, request):
+        job = self.queue.cancel(request.match_info["job_id"])
+        if job is None:
+            return _error("unknown job id", status=404)
+        return web.json_response(
+            {
+                "jobId": job.id,
+                "state": job.state.value,
+                "cancelRequested": not job.state.terminal,
+            }
+        )
+
+    async def healthz(self, request):
+        s = self.queue.stats()
+        return web.json_response(
+            {
+                "status": "ok",
+                "workers": s["workers"],
+                "queueDepth": s["queueDepth"],
+                "running": s["running"],
+            }
+        )
+
+    async def stats(self, request):
+        return web.json_response(
+            {
+                "queue": self.queue.stats(),
+                "crsCache": self.crs_cache.stats(),
+            }
+        )
+
     # -- app -----------------------------------------------------------------
+
+    async def _on_startup(self, app):
+        await self.pool.start()
+
+    async def _on_cleanup(self, app):
+        await self.pool.stop()
 
     def app(self) -> web.Application:
         app = web.Application(client_max_size=MAX_BODY)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
         app.router.add_post("/save_circuit", self.save_circuit)
         app.router.add_post(
             "/create_proof_without_mpc", self.create_proof_without_mpc
@@ -254,6 +338,12 @@ class ApiServer:
         app.router.add_get(
             "/get_circuit_files/{circuit_id}", self.get_circuit_files
         )
+        app.router.add_post("/jobs/prove", self.jobs_prove)
+        app.router.add_get("/jobs/{job_id}", self.job_status)
+        app.router.add_get("/jobs/{job_id}/result", self.job_result)
+        app.router.add_delete("/jobs/{job_id}", self.job_cancel)
+        app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/stats", self.stats)
         return app
 
 

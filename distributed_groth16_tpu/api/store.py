@@ -28,6 +28,17 @@ class CircuitStore:
         os.makedirs(self.root, exist_ok=True)
 
     def _dir(self, circuit_id: str) -> str:
+        # A circuit id must be exactly one non-dot path component: the old
+        # relpath-only check let "" and "." resolve to the store root and
+        # ".." to its parent (dirname("..") == "" — no separator to catch).
+        if (
+            not circuit_id
+            or circuit_id in (".", "..")
+            or "/" in circuit_id
+            or "\\" in circuit_id
+            or "\0" in circuit_id
+        ):
+            raise ValueError(f"bad circuit id {circuit_id!r}")
         path = os.path.normpath(os.path.join(self.root, circuit_id))
         if os.path.dirname(os.path.relpath(path, self.root)):
             raise ValueError(f"bad circuit id {circuit_id!r}")
@@ -36,7 +47,10 @@ class CircuitStore:
     def save_circuit(
         self, name: str, r1cs_bytes: bytes, witness_generator: bytes
     ) -> str:
-        if not name.replace("_", "").replace("-", "").isalnum():
+        if (
+            not name.isascii()
+            or not name.replace("_", "").replace("-", "").isalnum()
+        ):
             raise ValueError(f"bad circuit name {name!r}")
         # millis + random suffix: concurrent same-name saves never collide
         suffix = uuid.uuid4().hex[:8]

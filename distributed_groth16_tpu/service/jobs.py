@@ -1,0 +1,143 @@
+"""Proof-job records: the unit of work the service layer schedules.
+
+A `ProofJob` is everything the worker pool needs to run one proof off the
+request path — the parsed submission payload, lifecycle state, wall-clock
+stamps, per-phase timings, and (on completion) either a result payload or
+a structured error. State machine:
+
+    QUEUED --> RUNNING --> DONE
+       |          |`-----> FAILED
+       |          `------> CANCELLED   (cooperative, between phases)
+       `-----------------> CANCELLED   (never ran)
+
+All state transitions happen on the event-loop thread (the worker pool's
+tasks); the executor thread only reads `cancel_requested` (a
+threading.Event) and writes through the transition helpers' return values,
+so no per-job lock is needed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..utils.timers import PhaseTimings
+
+
+class JobState(str, enum.Enum):
+    QUEUED = "QUEUED"
+    RUNNING = "RUNNING"
+    DONE = "DONE"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (JobState.DONE, JobState.FAILED, JobState.CANCELLED)
+
+
+class JobCancelled(Exception):
+    """Raised by the executor at a cooperative cancellation point."""
+
+
+@dataclass
+class ProofJob:
+    """One queued proving request.
+
+    kind:    "prove" (single-prover) | "mpc_prove" (packed-MPC round)
+    fields:  the raw multipart fields of the submission (witness bytes or
+             JSON inputs) — parsed lazily by the executor, off the request
+             path.
+    """
+
+    kind: str
+    circuit_id: str
+    fields: dict[str, bytes]
+    l: int = 2
+    id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    state: JobState = JobState.QUEUED
+    created_at: float = field(default_factory=time.time)
+    started_at: float | None = None
+    finished_at: float | None = None
+    timings: PhaseTimings = field(default_factory=PhaseTimings)
+    result: dict[str, Any] | None = None
+    error: dict[str, Any] | None = None
+
+    def __post_init__(self):
+        import threading
+
+        # set from the event loop on DELETE, read from the executor thread
+        # at phase boundaries — the only cross-thread signal a job carries
+        self._cancel_flag = threading.Event()
+        self._done = asyncio.Event()
+
+    # -- executor-side hooks (worker thread) --------------------------------
+
+    def check_cancel(self) -> None:
+        """Cooperative cancellation point; the executor calls this between
+        phases so a cancel costs at most one phase, not the whole proof."""
+        if self._cancel_flag.is_set():
+            raise JobCancelled(self.id)
+
+    # -- loop-side transitions ----------------------------------------------
+
+    def mark_running(self) -> None:
+        self.state = JobState.RUNNING
+        self.started_at = time.time()
+
+    def mark_done(self, result: dict[str, Any]) -> None:
+        self.state = JobState.DONE
+        self.result = result
+        self._finish()
+
+    def mark_failed(self, exc: BaseException) -> None:
+        self.state = JobState.FAILED
+        self.error = {"error": str(exc), "type": type(exc).__name__}
+        self._finish()
+
+    def mark_cancelled(self) -> None:
+        self.state = JobState.CANCELLED
+        self._finish()
+
+    def request_cancel(self) -> None:
+        self._cancel_flag.set()
+
+    def _finish(self) -> None:
+        self.finished_at = time.time()
+        # the submission payload (witness bytes, up to the 100 MB body cap)
+        # is dead weight once the job is terminal — drop it so retained
+        # terminal jobs cost registry metadata, not upload-sized buffers
+        self.fields = {}
+        self._done.set()
+
+    async def wait(self) -> "ProofJob":
+        """Block until the job reaches a terminal state (the sync API
+        wrappers' submit-and-await path)."""
+        await self._done.wait()
+        return self
+
+    @property
+    def runtime_s(self) -> float | None:
+        if self.started_at is None or self.finished_at is None:
+            return None
+        return self.finished_at - self.started_at
+
+    def to_dict(self) -> dict[str, Any]:
+        """The GET /jobs/{id} status DTO."""
+        out = {
+            "jobId": self.id,
+            "kind": self.kind,
+            "circuitId": self.circuit_id,
+            "state": self.state.value,
+            "createdAt": self.created_at,
+            "startedAt": self.started_at,
+            "finishedAt": self.finished_at,
+            "phases": self.timings.as_millis(),
+        }
+        if self.error is not None:
+            out["error"] = self.error
+        return out

@@ -1,0 +1,176 @@
+"""Asyncio job queue with admission control — the backpressure layer.
+
+The queue is also the job registry and the service's bookkeeping core:
+every submitted job stays addressable by id for status/result/cancel, and
+completion statistics (counters, runtime EMA, merged phase timings) feed
+both the `/stats` route and the retryAfter hint on rejections.
+
+Admission control: at most `bound` jobs may be waiting (QUEUED). A submit
+past that raises `QueueFullError` carrying a `retry_after_s` hint — the
+API maps it to HTTP 429 — estimated as (depth / workers) x the observed
+mean job runtime, falling back to a configured constant before any job
+has completed.
+
+Everything here runs on the event-loop thread except `record_timings`
+(PhaseTimings is internally locked), so plain attributes suffice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from collections import deque
+
+from ..utils.timers import PhaseTimings
+from .jobs import JobState, ProofJob
+
+
+class QueueFullError(Exception):
+    """Structured rejection: the queue is at its admission bound."""
+
+    def __init__(self, bound: int, depth: int, retry_after_s: float):
+        self.bound = bound
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"job queue full ({depth}/{bound} queued); "
+            f"retry in ~{retry_after_s:.0f}s"
+        )
+
+
+class JobQueue:
+    def __init__(
+        self,
+        bound: int = 64,
+        workers: int = 2,
+        retry_after_s: float = 5.0,
+        history_bound: int = 1024,
+    ):
+        self.bound = bound
+        self.workers = max(1, workers)
+        self.default_retry_after_s = retry_after_s
+        # terminal jobs stay addressable for status polling, but only the
+        # `history_bound` most recent — without eviction the registry (and
+        # every result payload) grows without bound on a long-lived service
+        self.history_bound = history_bound
+        self._terminal_order: deque[str] = deque()
+        self.jobs: dict[str, ProofJob] = {}
+        self._q: asyncio.Queue[ProofJob] = asyncio.Queue()
+        self._queued_ids: set[str] = set()
+        self._running_ids: set[str] = set()
+        # counters for /stats
+        self.submitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.failed = 0
+        self.cancelled = 0
+        self._runtime_ema_s: float | None = None
+        self.aggregate_timings = PhaseTimings()
+
+    # -- submission (request path) ------------------------------------------
+
+    def submit(self, job: ProofJob) -> ProofJob:
+        depth = len(self._queued_ids)
+        if depth >= self.bound:
+            self.rejected += 1
+            raise QueueFullError(self.bound, depth, self.retry_after_hint())
+        self.jobs[job.id] = job
+        self._queued_ids.add(job.id)
+        self._q.put_nowait(job)
+        self.submitted += 1
+        return job
+
+    def retry_after_hint(self) -> float:
+        """Seconds until a queue slot plausibly frees: one full drain of
+        the current backlog through the worker pool at the observed mean
+        job runtime."""
+        if self._runtime_ema_s is None:
+            return self.default_retry_after_s
+        drains = math.ceil((len(self._queued_ids) + 1) / self.workers)
+        return max(1.0, drains * self._runtime_ema_s)
+
+    # -- worker side ---------------------------------------------------------
+
+    async def get(self) -> ProofJob:
+        job = await self._q.get()
+        self._queued_ids.discard(job.id)
+        return job
+
+    def on_started(self, job: ProofJob) -> None:
+        self._running_ids.add(job.id)
+
+    def on_finished(self, job: ProofJob) -> None:
+        self._running_ids.discard(job.id)
+        if job.state is JobState.DONE:
+            self.completed += 1
+        elif job.state is JobState.FAILED:
+            self.failed += 1
+        elif job.state is JobState.CANCELLED:
+            self.cancelled += 1
+        rt = job.runtime_s
+        if rt is not None:
+            self._runtime_ema_s = (
+                rt
+                if self._runtime_ema_s is None
+                else 0.7 * self._runtime_ema_s + 0.3 * rt
+            )
+        self.aggregate_timings.merge(job.timings)
+        self._note_terminal(job)
+
+    def _note_terminal(self, job: ProofJob) -> None:
+        self._terminal_order.append(job.id)
+        while len(self._terminal_order) > self.history_bound:
+            jid = self._terminal_order.popleft()
+            j = self.jobs.get(jid)
+            if j is not None and j.state.terminal:
+                del self.jobs[jid]
+
+    def drain_pending(self) -> list[ProofJob]:
+        """Pop every still-QUEUED job (shutdown path): the caller owns
+        transitioning them to a terminal state so sync waiters and status
+        pollers don't see QUEUED forever."""
+        out = []
+        while True:
+            try:
+                job = self._q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            self._queued_ids.discard(job.id)
+            if job.state is JobState.QUEUED:
+                out.append(job)
+        return out
+
+    # -- control plane -------------------------------------------------------
+
+    def cancel(self, job_id: str) -> ProofJob | None:
+        """Cancel a job. QUEUED jobs flip to CANCELLED immediately and are
+        skipped when popped; RUNNING jobs get a cooperative cancel request
+        honored at the executor's next phase boundary. Terminal jobs are a
+        no-op. Returns the job, or None if unknown."""
+        job = self.jobs.get(job_id)
+        if job is None:
+            return None
+        if job.state is JobState.QUEUED:
+            self._queued_ids.discard(job.id)
+            job.request_cancel()
+            job.mark_cancelled()
+            self.cancelled += 1
+            self._note_terminal(job)
+        elif job.state is JobState.RUNNING:
+            job.request_cancel()
+        return job
+
+    def stats(self) -> dict:
+        return {
+            "queueDepth": len(self._queued_ids),
+            "queueBound": self.bound,
+            "workers": self.workers,
+            "running": len(self._running_ids),
+            "submitted": self.submitted,
+            "rejected": self.rejected,
+            "completed": self.completed,
+            "failed": self.failed,
+            "cancelled": self.cancelled,
+            "meanRuntimeS": self._runtime_ema_s,
+            "phases": self.aggregate_timings.as_millis(),
+        }

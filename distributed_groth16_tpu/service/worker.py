@@ -1,0 +1,219 @@
+"""Bounded worker pool + the proof executor it drives.
+
+The pool is DG16_SERVICE_WORKERS asyncio tasks pulling from the JobQueue;
+each job's body runs in a thread (`asyncio.to_thread`) because proving is
+synchronous JAX compute and the in-process MPC round owns its own event
+loop (`simulate_network_round` calls `asyncio.run`). At most `workers`
+proofs execute concurrently — the admission bound on the queue plus this
+pool is the whole backpressure story.
+
+`ProofExecutor` is the single proving path of the service: witness
+generation, CRS packing (through the packed-CRS cache), and the MPC round
+via PR 1's `run_round_with_retries` so a transient transport fault costs
+one round, not the job. Cooperative cancellation points sit between
+phases (`job.check_cancel()`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..frontend.ark_serde import proof_to_bytes
+from ..frontend.readers import read_wtns
+from ..models.groth16 import (
+    CompiledR1CS,
+    distributed_prove_party,
+    pack_from_witness,
+    pack_proving_key,
+    reassemble_proof,
+)
+from ..models.groth16.prove import prove_single
+from ..ops.field import fr
+from ..parallel.net import run_round_with_retries
+from ..parallel.pss import PackedSharingParams
+from ..utils.config import ServiceConfig
+from ..utils.timers import phase
+from .crs_cache import CrsCache
+from .jobs import JobCancelled, JobState, ProofJob
+from .queue import JobQueue
+
+log = logging.getLogger(__name__)
+
+
+class ProofExecutor:
+    """Runs one ProofJob to a result dict — always on a worker thread."""
+
+    def __init__(
+        self,
+        store,
+        crs_cache: CrsCache | None = None,
+        cfg: ServiceConfig | None = None,
+    ):
+        self.store = store
+        self.cfg = cfg or ServiceConfig()
+        # explicit None check: an EMPTY CrsCache is falsy (it has __len__),
+        # so `crs_cache or ...` would silently split the server's cache
+        # from the executor's
+        self.crs_cache = (
+            crs_cache
+            if crs_cache is not None
+            else CrsCache(self.cfg.crs_cache_size)
+        )
+
+    # -- witness -------------------------------------------------------------
+
+    def _witness(self, job: ProofJob, r1cs) -> list[int]:
+        fields = job.fields
+        if "witness_file" in fields:
+            z = read_wtns(fields["witness_file"])
+        elif "input_file" in fields:
+            # the reference's primary prove flow (mpc-api/src/main.rs:
+            # 282-421): JSON inputs -> circom WASM witness generation on
+            # the pure-Python interpreter (frontend/wasm_vm.py)
+            import json
+
+            from ..frontend.witness_calculator import WitnessCalculator
+
+            _, wasm = self.store.get_files(job.circuit_id)
+            if not wasm:
+                raise ValueError(
+                    "circuit was saved without a witness_generator wasm; "
+                    "upload a .wtns in the witness_file field instead"
+                )
+            inputs = json.loads(fields["input_file"].decode())
+            wc = WitnessCalculator(wasm)
+            z = wc.calculate_witness(inputs)
+        else:
+            raise ValueError("need witness_file or input_file")
+        if len(z) != r1cs.num_wires or not r1cs.is_satisfied(z):
+            raise ValueError("witness does not satisfy the circuit")
+        return z
+
+    # -- CRS -----------------------------------------------------------------
+
+    def packed_crs(self, job: ProofJob, pk, pp: PackedSharingParams):
+        """All-party CRS shares through the LRU cache. The key is the
+        circuit plus every parameter the shares depend on (l determines
+        n/t and the chunking)."""
+        key = (job.circuit_id, pp.l)
+        return self.crs_cache.get_or_pack(
+            key, lambda: pack_proving_key(pk, pp, strip=True)
+        )
+
+    # -- the proving path ----------------------------------------------------
+
+    def run(self, job: ProofJob) -> dict:
+        timings = job.timings
+        with phase("load", timings):
+            r1cs, pk = self.store.load(job.circuit_id)
+        job.check_cancel()
+        with phase("witness", timings):
+            z = self._witness(job, r1cs)
+        job.check_cancel()
+        F = fr()
+        z_mont = F.encode(z)
+        if job.kind == "prove":
+            with phase("prove", timings):
+                comp = CompiledR1CS(r1cs)
+                proof = prove_single(pk, comp, z_mont)
+        elif job.kind == "mpc_prove":
+            pp = PackedSharingParams(job.l)
+            with phase("packing", timings):
+                comp = CompiledR1CS(r1cs)
+                qap_shares = comp.qap(z_mont).pss(pp)
+                crs_shares = self.packed_crs(job, pk, pp)
+                ni = r1cs.num_instance
+                a_sh = pack_from_witness(pp, z_mont[1:])
+                ax_sh = pack_from_witness(pp, z_mont[ni:])
+            job.check_cancel()
+
+            async def party(net, d):
+                return await distributed_prove_party(
+                    pp, d[0], d[1], d[2], d[3], net
+                )
+
+            with phase("MPC Proof", timings):
+                res = run_round_with_retries(
+                    pp.n,
+                    party,
+                    [
+                        (crs_shares[i], qap_shares[i], a_sh[i], ax_sh[i])
+                        for i in range(pp.n)
+                    ],
+                    retries=self.cfg.round_retries,
+                )
+            proof = reassemble_proof(res[0], pk)
+        else:
+            raise ValueError(f"unknown job kind {job.kind!r}")
+        job.check_cancel()
+        return {
+            "circuitId": job.circuit_id,
+            "proof": list(proof_to_bytes(proof)),
+            "phases": timings.as_millis(),
+        }
+
+
+class WorkerPool:
+    """DG16_SERVICE_WORKERS asyncio tasks draining the JobQueue."""
+
+    def __init__(self, queue: JobQueue, executor: ProofExecutor, workers: int = 2):
+        self.queue = queue
+        self.executor = executor
+        self.workers = max(1, workers)
+        self._tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        for i in range(self.workers):
+            self._tasks.append(
+                asyncio.create_task(self._worker(i), name=f"dg16-worker-{i}")
+            )
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        # jobs still QUEUED will never get a worker now — transition them
+        # so sync waiters and status pollers see a terminal state instead
+        # of QUEUED forever (and of stalling graceful shutdown)
+        for job in self.queue.drain_pending():
+            job.mark_failed(RuntimeError("service shutting down"))
+            self.queue.on_finished(job)
+
+    async def _worker(self, idx: int) -> None:
+        while True:
+            job = await self.queue.get()
+            if job.state is not JobState.QUEUED:
+                continue  # cancelled while queued — never runs
+            job.mark_running()
+            self.queue.on_started(job)
+            fut = asyncio.ensure_future(
+                asyncio.to_thread(self.executor.run, job)
+            )
+            try:
+                result = await asyncio.shield(fut)
+            except asyncio.CancelledError:
+                # pool shutdown. The proof thread can't be interrupted, so
+                # ask for a phase-boundary stop, wait it out, and record
+                # the real outcome — a proof that finished during shutdown
+                # is a result, not a failure.
+                job.request_cancel()
+                try:
+                    result = await fut
+                except JobCancelled:
+                    job.mark_cancelled()
+                except Exception as e:  # noqa: BLE001
+                    job.mark_failed(e)
+                else:
+                    job.mark_done(result)
+                self.queue.on_finished(job)
+                raise
+            except JobCancelled:
+                job.mark_cancelled()
+            except Exception as e:  # noqa: BLE001 — job-level CustomError
+                log.warning("job %s failed: %s", job.id, e)
+                job.mark_failed(e)
+            else:
+                job.mark_done(result)
+            self.queue.on_finished(job)

@@ -69,6 +69,56 @@ class NetConfig:
         )
 
 
+@dataclass(frozen=True)
+class ServiceConfig:
+    """Proof-job service knobs (service/ + api/server.py). Every field has
+    a DG16_SERVICE_* env override so a deployment can be tuned without code
+    changes. See docs/SERVICE.md for the backpressure semantics.
+
+      * workers — bounded worker pool size: at most this many proofs
+        execute concurrently; everything else waits in the queue.
+      * queue_bound — admission control: jobs waiting (QUEUED) beyond this
+        are rejected with a structured queue-full error that the API maps
+        to HTTP 429 + a retryAfter hint.
+      * crs_cache_size — LRU capacity (entries) of the packed-CRS cache,
+        keyed by (circuit_id, packing params). 0 disables caching.
+      * round_retries — transient-fault re-runs per MPC round, forwarded
+        to parallel.net.run_round_with_retries.
+      * retry_after_s — fallback retryAfter hint (seconds) reported on
+        queue-full rejections before any job has completed (after that the
+        hint is estimated from observed job runtimes).
+      * job_history — how many terminal (DONE/FAILED/CANCELLED) jobs stay
+        addressable via GET /jobs/{id}; older ones are evicted so a
+        long-lived service doesn't grow its registry without bound.
+    """
+
+    workers: int = 2
+    queue_bound: int = 64
+    crs_cache_size: int = 8
+    round_retries: int = 2
+    retry_after_s: float = 5.0
+    job_history: int = 1024
+
+    @staticmethod
+    def from_env() -> "ServiceConfig":
+        def i(name: str, default: int) -> int:
+            v = os.environ.get(name)
+            return int(v) if v not in (None, "") else default
+
+        def f(name: str, default: float) -> float:
+            v = os.environ.get(name)
+            return float(v) if v not in (None, "") else default
+
+        return ServiceConfig(
+            workers=i("DG16_SERVICE_WORKERS", 2),
+            queue_bound=i("DG16_SERVICE_QUEUE_BOUND", 64),
+            crs_cache_size=i("DG16_SERVICE_CRS_CACHE", 8),
+            round_retries=i("DG16_SERVICE_ROUND_RETRIES", 2),
+            retry_after_s=f("DG16_SERVICE_RETRY_AFTER_S", 5.0),
+            job_history=i("DG16_SERVICE_JOB_HISTORY", 1024),
+        )
+
+
 @dataclass
 class Opt:
     id: int  # party id (0 = king)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -36,15 +37,37 @@ def _emit(msg: str, *args) -> None:
 
 @dataclass
 class PhaseTimings:
-    """Collected {phase: seconds} for one operation (e.g. one proof)."""
+    """Collected {phase: seconds} for one operation (e.g. one proof).
+
+    The service layer's worker pool hands one instance to each job but
+    merges them all into one service-wide aggregate for `/stats`, so both
+    `record` and `merge` may be hit from several worker threads at once —
+    a lock keeps the read-modify-write on each phase bucket atomic.
+    """
 
     phases: dict[str, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def record(self, name: str, seconds: float) -> None:
-        self.phases[name] = self.phases.get(name, 0.0) + seconds
+        with self._lock:
+            self.phases[name] = self.phases.get(name, 0.0) + seconds
+
+    def merge(self, other: "PhaseTimings") -> "PhaseTimings":
+        """Fold `other`'s phases into self (summing shared names) —
+        the `/stats` aggregation primitive. Returns self for chaining."""
+        for name, seconds in other.snapshot().items():
+            self.record(name, seconds)
+        return self
+
+    def snapshot(self) -> dict[str, float]:
+        """Consistent copy of the phase map."""
+        with self._lock:
+            return dict(self.phases)
 
     def as_millis(self) -> dict[str, float]:
-        return {k: round(v * 1e3, 3) for k, v in self.phases.items()}
+        return {k: round(v * 1e3, 3) for k, v in self.snapshot().items()}
 
 
 @contextmanager

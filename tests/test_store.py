@@ -1,0 +1,63 @@
+"""CircuitStore hardening: `_dir` path-traversal rejection and
+`save_circuit` name validation (the artifact directory is addressed by
+client-supplied ids, so it must never resolve outside the store root)."""
+
+import os
+
+import pytest
+
+from distributed_groth16_tpu.api.store import CircuitStore
+from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
+from distributed_groth16_tpu.frontend.readers import write_r1cs
+
+
+@pytest.fixture
+def store(tmp_path):
+    return CircuitStore(str(tmp_path))
+
+
+BAD_IDS = [
+    "../x",
+    "..",
+    ".",
+    "",
+    "a/b",
+    "a\\b",
+    "/etc/passwd",
+    "circuit_x/../../escape",
+    "a\0b",
+]
+
+
+@pytest.mark.parametrize("cid", BAD_IDS)
+def test_dir_rejects_traversal(store, cid):
+    with pytest.raises(ValueError, match="bad circuit id"):
+        store._dir(cid)
+
+
+def test_dir_accepts_plain_component(store):
+    path = store._dir("circuit_mul_1700000000000_abcd1234")
+    assert os.path.dirname(os.path.relpath(path, store.root)) == ""
+    # and the lookups funnel through the same check
+    with pytest.raises(ValueError, match="bad circuit id"):
+        store.load("../x")
+    with pytest.raises(ValueError, match="bad circuit id"):
+        store.get_files("")
+
+
+BAD_NAMES = ["", "a/b", "../x", "a b", "a.b", "é", "name\n"]
+
+
+@pytest.mark.parametrize("name", BAD_NAMES)
+def test_save_circuit_rejects_bad_names(store, name):
+    with pytest.raises(ValueError, match="bad circuit name"):
+        store.save_circuit(name, b"", b"")
+
+
+def test_save_circuit_accepts_good_name(store):
+    r1cs, _ = mult_chain_circuit(3, 2).finish()
+    cid = store.save_circuit("ok_name-1", write_r1cs(r1cs), b"")
+    assert cid.startswith("circuit_ok_name-1_")
+    # round-trips through the validated _dir
+    r1cs_bytes, wasm = store.get_files(cid)
+    assert r1cs_bytes == write_r1cs(r1cs) and wasm == b""
