@@ -47,6 +47,12 @@ _PRINT_LOCK = threading.Lock()
 # module imports (programmatic/test use).
 _ROLL_MODE = os.environ.get("DG16_PALLAS_ROLL", "fori")
 
+# telemetry registry module, bound by main() after backend init (imports
+# in the signal/watchdog emit path could deadlock on the import lock);
+# family locks are re-entrant, so snapshotting from the SIGTERM handler
+# cannot deadlock against an interrupted increment.
+_METRICS = None
+
 
 def _emit(
     res: dict, stage_s: dict, platform: str, from_signal: bool = False
@@ -100,6 +106,13 @@ def _do_emit(res: dict, stage_s: dict, platform: str) -> None:
         "pallas_roll": _ROLL_MODE,
         **{k: v for k, v in res.items() if k not in ("metric", "value")},
     }
+    if _METRICS is not None:
+        try:
+            # same series names as GET /metrics (docs/OBSERVABILITY.md),
+            # so bench lines and service scrapes join on metric name
+            out["metrics"] = _METRICS.registry().snapshot()
+        except Exception:  # noqa: BLE001 — telemetry never kills the emit
+            pass
     print(json.dumps(out), flush=True)
 
 
@@ -165,13 +178,24 @@ def main() -> None:
     from distributed_groth16_tpu.ops.limb_kernels import _msm_tree_jit, lg1
     from distributed_groth16_tpu.ops.msm import encode_scalars_std
 
+    from distributed_groth16_tpu.telemetry import metrics as telemetry_metrics
     from distributed_groth16_tpu.utils.benchtools import marginal_cost
 
     # one authoritative roll-mode capture: whatever limb_kernels read at
     # ITS import is what the kernels run with — mirror it into the global
     # the (possibly signal-driven) emit path reports
-    global _ROLL_MODE
+    global _ROLL_MODE, _METRICS
     _ROLL_MODE = limb_kernels._ROLL_MODE
+    _METRICS = telemetry_metrics
+    bench_stage_seconds = telemetry_metrics.registry().histogram(
+        "bench_stage_seconds", "Wall-clock seconds per bench stage",
+        ("stage",),
+    )
+    bench_msm_rate = telemetry_metrics.registry().gauge(
+        "bench_msm_scalar_muls_per_sec",
+        "Measured steady-state MSM throughput, per size",
+        ("size",),
+    )
 
     inner = _msm_tree_jit.__wrapped__
     rng = np.random.default_rng(0)
@@ -244,6 +268,10 @@ def main() -> None:
             )
             break
         stage_s[f"msm_2e{log2n}"] = round(time.time() - t0, 1)
+        bench_stage_seconds.labels(stage=f"msm_2e{log2n}").observe(
+            time.time() - t0
+        )
+        bench_msm_rate.labels(size=f"2e{log2n}").set(muls_per_sec)
         res["metric"] = f"msm_g1_scalar_muls_per_sec_2e{log2n}"
         res["value"] = round(muls_per_sec, 1)
         res["per_msm_ms"] = round(per_msm * 1e3, 1)
@@ -274,6 +302,9 @@ def main() -> None:
             t0 = time.time()
             res["ntt_2e20_ms"] = round(marginal_cost(make_ntt, (x,)) * 1e3, 1)
             stage_s["ntt_2e20"] = round(time.time() - t0, 1)
+            bench_stage_seconds.labels(stage="ntt_2e20").observe(
+                time.time() - t0
+            )
         except Exception as e:
             res.setdefault("errors", []).append(
                 f"ntt: {type(e).__name__}: {e}"

@@ -20,6 +20,7 @@ Usage:
   python -m distributed_groth16_tpu.api.cli job status --job-id JOB
   python -m distributed_groth16_tpu.api.cli job watch --job-id JOB \
       [--interval 2] [--out proof.bin]
+  python -m distributed_groth16_tpu.api.cli metrics
 
 Queue-full submissions (HTTP 429) exit with the server's retryAfter hint
 (docs/SERVICE.md describes the backpressure semantics).
@@ -150,6 +151,18 @@ def cmd_job_watch(args) -> dict:
     return result
 
 
+def cmd_metrics(args) -> dict:
+    """GET /metrics — print the server's Prometheus text exposition
+    verbatim (pipe into promtool or grep; docs/OBSERVABILITY.md)."""
+    resp = requests.get(f"{args.url}/metrics", timeout=60)
+    if resp.status_code != 200:
+        raise SystemExit(
+            f"server error: HTTP {resp.status_code} — {resp.text[:300]}"
+        )
+    print(resp.text, end="")
+    raise SystemExit(0)
+
+
 def cmd_export_eth(args) -> dict:
     """Local conversion — no server round-trip needed."""
     from ..frontend.ark_serde import proof_from_bytes
@@ -205,6 +218,11 @@ def main(argv=None) -> None:
     sp.add_argument("--interval", type=float, default=2.0)
     sp.add_argument("--out", default=None, help="write proof bytes here")
     sp.set_defaults(fn=cmd_job_watch)
+
+    sp = sub.add_parser(
+        "metrics", help="dump the server's /metrics Prometheus text"
+    )
+    sp.set_defaults(fn=cmd_metrics)
 
     sp = sub.add_parser("verify")
     sp.add_argument("--circuit-id", required=True)

@@ -24,6 +24,8 @@ routes above, funnels through one queue + bounded worker pool):
   GET    /healthz         liveness + pool shape
   GET    /stats           queue depth/counters, CRS-cache hit rate,
                           per-phase timing aggregates
+  GET    /metrics         Prometheus text exposition of the process-wide
+                          telemetry registry (docs/OBSERVABILITY.md)
 
 Backpressure: submissions past the queue bound get HTTP 429 with a
 `retryAfter` hint (seconds). Sync responses keep the reference's camelCase
@@ -43,6 +45,7 @@ from aiohttp import web
 
 from ..frontend.ark_serde import proof_from_bytes
 from ..models.groth16 import verify
+from ..telemetry import metrics as telemetry_metrics
 from ..service import (
     CrsCache,
     JobQueue,
@@ -315,6 +318,14 @@ class ApiServer:
             }
         )
 
+    async def metrics(self, request):
+        """Prometheus text format 0.0.4 scrape endpoint."""
+        return web.Response(
+            text=telemetry_metrics.registry().render_prometheus(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
     # -- app -----------------------------------------------------------------
 
     async def _on_startup(self, app):
@@ -344,6 +355,7 @@ class ApiServer:
         app.router.add_delete("/jobs/{job_id}", self.job_cancel)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/stats", self.stats)
+        app.router.add_get("/metrics", self.metrics)
         return app
 
 

@@ -27,6 +27,7 @@ from ...parallel.dmsm import d_msm
 from ...parallel.net import Net
 from ...parallel.packing import pack_consecutive
 from ...parallel.pss import PackedSharingParams
+from ...telemetry import tracing as _tracing
 from .ext_wit import h as ext_wit_h
 from .keys import Proof, ProvingKey
 from .proving_key import PackedProvingKeyShare
@@ -78,8 +79,9 @@ async def compute_A(
     N=None,
     r: int = 0,
 ):
-    prod = await d_msm(g1(), S, a_share, pp, net, sid)
-    return _acc(g1(), L, _maybe_mul(g1(), N, r), prod)
+    with _tracing.span("prove.A", party=net.party_id, sid=sid):
+        prod = await d_msm(g1(), S, a_share, pp, net, sid)
+        return _acc(g1(), L, _maybe_mul(g1(), N, r), prod)
 
 
 async def compute_B(
@@ -92,8 +94,9 @@ async def compute_B(
     K=None,
     s: int = 0,
 ):
-    prod = await d_msm(g2(), V, a_share, pp, net, sid)
-    return _acc(g2(), Z, _maybe_mul(g2(), K, s), prod)
+    with _tracing.span("prove.B", party=net.party_id, sid=sid):
+        prod = await d_msm(g2(), V, a_share, pp, net, sid)
+        return _acc(g2(), Z, _maybe_mul(g2(), K, s), prod)
 
 
 async def compute_C(
@@ -110,26 +113,27 @@ async def compute_C(
     r: int = 0,
     s: int = 0,
 ):
-    msms = [
-        d_msm(g1(), W, ax_share, pp, net, 0),
-        d_msm(g1(), U, h_share, pp, net, 1),
-    ]
-    # the H-query MSM only feeds the r-weighted term — skip the whole
-    # distributed round when r == 0 (the deterministic-proof path of the
-    # examples and service)
-    if r % fr().p != 0:
-        msms.append(d_msm(g1(), H, a_share, pp, net, 2))
-    results = await asyncio.gather(*msms)
-    w, u = results[0], results[1]
-    h_msm = results[2] if len(results) > 2 else None
-    return _acc(
-        g1(),
-        w,
-        u,
-        _maybe_mul(g1(), A, s),
-        _maybe_mul(g1(), M, r),
-        _maybe_mul(g1(), h_msm, r),
-    )
+    with _tracing.span("prove.C", party=net.party_id):
+        msms = [
+            d_msm(g1(), W, ax_share, pp, net, 0),
+            d_msm(g1(), U, h_share, pp, net, 1),
+        ]
+        # the H-query MSM only feeds the r-weighted term — skip the whole
+        # distributed round when r == 0 (the deterministic-proof path of
+        # the examples and service)
+        if r % fr().p != 0:
+            msms.append(d_msm(g1(), H, a_share, pp, net, 2))
+        results = await asyncio.gather(*msms)
+        w, u = results[0], results[1]
+        h_msm = results[2] if len(results) > 2 else None
+        return _acc(
+            g1(),
+            w,
+            u,
+            _maybe_mul(g1(), A, s),
+            _maybe_mul(g1(), M, r),
+            _maybe_mul(g1(), h_msm, r),
+        )
 
 
 def pack_from_witness(
@@ -193,30 +197,33 @@ async def distributed_prove_party(
     zk = (r % fr().p, s % fr().p) != (0, 0)
     if zk and pub is None:
         raise ValueError("randomized proof needs pub=public_prove_consts(pk)")
-    h_share = await ext_wit_h(qap_share, pp, net)
-    # A and B are independent distributed rounds — overlap them on separate
-    # channels (the reference runs them back-to-back on channel Zero)
-    pi_a, pi_b = await asyncio.gather(
-        compute_A(pp, crs_share.s, a_share, net, 0,
-                  N=pub["N"] if zk else None, r=r),
-        compute_B(pp, crs_share.v, a_share, net, 1,
-                  K=pub["K"] if zk else None, s=s),
-    )
-    pi_c = await compute_C(
-        pp,
-        crs_share.w,
-        crs_share.u,
-        crs_share.h,
-        a_share,
-        ax_share,
-        h_share,
-        net,
-        A=g1().add(pi_a, pub["A0"]) if zk else None,
-        M=pub["M"] if zk else None,
-        r=r,
-        s=s,
-    )
-    return PartyProofShare(a=pi_a, b=pi_b, c=pi_c)
+    with _tracing.span("prove.party", party=net.party_id):
+        with _tracing.span("prove.h", party=net.party_id):
+            h_share = await ext_wit_h(qap_share, pp, net)
+        # A and B are independent distributed rounds — overlap them on
+        # separate channels (the reference runs them back-to-back on
+        # channel Zero)
+        pi_a, pi_b = await asyncio.gather(
+            compute_A(pp, crs_share.s, a_share, net, 0,
+                      N=pub["N"] if zk else None, r=r),
+            compute_B(pp, crs_share.v, a_share, net, 1,
+                      K=pub["K"] if zk else None, s=s),
+        )
+        pi_c = await compute_C(
+            pp,
+            crs_share.w,
+            crs_share.u,
+            crs_share.h,
+            a_share,
+            ax_share,
+            h_share,
+            net,
+            A=g1().add(pi_a, pub["A0"]) if zk else None,
+            M=pub["M"] if zk else None,
+            r=r,
+            s=s,
+        )
+        return PartyProofShare(a=pi_a, b=pi_b, c=pi_c)
 
 
 def prove_single(

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from ..ops.field import fr
 from ..ops.ntt import bitrev_perm, domain
+from ..telemetry import tracing as _tracing
 from .net import Net
 from .pss import PackedSharingParams
 
@@ -182,26 +183,29 @@ async def _d_transform(
     F = fr()
     log.debug("d_%sfft: party %d stage-1 m=%d (sid=%d)",
               "i" if inverse else "", net.party_id, m, sid)
-    if inverse:
-        share_vec = F.mul(share_vec, dom._size_inv)
-    local = _fft1_local(share_vec, wpows, logm, logl, inverse)
+    with _tracing.span(
+        "dfft.ifft" if inverse else "dfft.fft", party=net.party_id, sid=sid
+    ):
+        if inverse:
+            share_vec = F.mul(share_vec, dom._size_inv)
+        local = _fft1_local(share_vec, wpows, logm, logl, inverse)
 
-    gathered = await net.gather_to_king(local, sid)
-    if king_clear:
-        # Fused mode: leave the clear natural-order result on the king (the
-        # caller's next step is a king-side combine — re-packing and
-        # scattering here would be immediately undone by a gather).
-        if not net.is_king:
-            return None
-        return _king_clear_array(
-            jnp.stack(gathered, axis=0), pp, logm, degree2, inverse, wpows
-        )
-    out = None
-    if net.is_king:
-        out = _king_tail(
-            gathered, pp, logm, rearrange, pad, degree2, inverse, wpows
-        )
-    return await net.scatter_from_king(out, sid)
+        gathered = await net.gather_to_king(local, sid)
+        if king_clear:
+            # Fused mode: leave the clear natural-order result on the king
+            # (the caller's next step is a king-side combine — re-packing
+            # and scattering here would be immediately undone by a gather).
+            if not net.is_king:
+                return None
+            return _king_clear_array(
+                jnp.stack(gathered, axis=0), pp, logm, degree2, inverse, wpows
+            )
+        out = None
+        if net.is_king:
+            out = _king_tail(
+                gathered, pp, logm, rearrange, pad, degree2, inverse, wpows
+            )
+        return await net.scatter_from_king(out, sid)
 
 
 async def d_fft(

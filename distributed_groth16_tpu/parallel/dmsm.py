@@ -17,6 +17,7 @@ import logging
 from ..ops.curve import CurvePoints
 from ..ops.field import fr
 from ..ops.msm import msm
+from ..telemetry import tracing as _tracing
 from .net import Net
 from .pss import PackedSharingParams
 
@@ -43,17 +44,18 @@ async def d_msm(
     F = scalar_field or fr()
     log.debug("d_msm: party %d local MSM over %d bases (sid=%d)",
               net.party_id, bases.shape[0], sid)
-    # wide standard forms (r381 -> 17 limbs) pass through unchanged:
-    # ops/msm.py's digit decomposition is width-aware as of r5
-    std = F.from_mont(scalar_shares)
-    local = msm(curve, bases, std)
+    with _tracing.span("dmsm", party=net.party_id, sid=sid):
+        # wide standard forms (r381 -> 17 limbs) pass through unchanged:
+        # ops/msm.py's digit decomposition is width-aware as of r5
+        std = F.from_mont(scalar_shares)
+        local = msm(curve, bases, std)
 
-    def king(points):
-        import jax.numpy as jnp
+        def king(points):
+            import jax.numpy as jnp
 
-        stacked = jnp.stack(points, axis=0)  # (n, 3) + elem
-        partials = pp.unpackexp(curve, stacked, degree2=True)  # (l, 3) + elem
-        total = curve.sum(partials, axis=0)
-        return [total] * pp.n
+            stacked = jnp.stack(points, axis=0)  # (n, 3) + elem
+            partials = pp.unpackexp(curve, stacked, degree2=True)  # (l, 3)+
+            total = curve.sum(partials, axis=0)
+            return [total] * pp.n
 
-    return await net.king_compute(local, king, sid)
+        return await net.king_compute(local, king, sid)

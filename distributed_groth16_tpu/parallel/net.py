@@ -25,8 +25,14 @@ zero-copy, no host round-trip.
 
 Fault tolerance: every collective takes a per-op `timeout=` (falling back to
 the net's NetConfig.op_timeout_s) and raises a structured MpcNetError —
-MpcTimeoutError / MpcDisconnectError carrying (party, peer, sid, op) —
-instead of hanging on a dead or silent peer. See docs/ROBUSTNESS.md.
+MpcTimeoutError / MpcDisconnectError carrying (party, peer, sid, op, and —
+when proving a service job — the job's correlation id) — instead of
+hanging on a dead or silent peer. See docs/ROBUSTNESS.md.
+
+Telemetry: every collective records a per-op latency sample
+(collective_seconds{op=}) and, when tracing is active, a net.* span;
+deadline expiries and round retries/failures increment counters. See
+docs/OBSERVABILITY.md.
 
 Backends:
   * LocalSimNet — n asyncio tasks + in-memory queues, the LocalTestNet /
@@ -40,9 +46,14 @@ Backends:
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
+import time
+from contextlib import contextmanager
 from typing import Any, Awaitable, Callable, Protocol, Sequence
 
+from ..telemetry import metrics as _tm
+from ..telemetry import tracing as _tracing
 from ..utils.config import NetConfig
 
 # module-level tracing, the role of the reference's log/env_logger calls
@@ -52,11 +63,61 @@ log = logging.getLogger(__name__)
 
 CHANNELS = 3
 
+# -- telemetry ---------------------------------------------------------------
+# Per-op latency histograms and fault counters (docs/OBSERVABILITY.md).
+# Children are pre-bound at import: the per-call cost on the collectives'
+# hot path is one dict lookup + an in-place add, no allocations.
+_REG = _tm.registry()
+_COLLECTIVE_SECONDS = _REG.histogram(
+    "collective_seconds",
+    "Latency of one star collective, per op",
+    ("op",),
+)
+_COLL = {
+    op: _COLLECTIVE_SECONDS.labels(op=op)
+    for op in (
+        "send_to", "recv_from", "gather_to_king", "scatter_from_king",
+        "king_compute",
+    )
+}
+_TIMEOUTS = _REG.counter(
+    "net_timeouts_total", "Collective deadline expiries, per op", ("op",)
+)
+_TO = {op: _TIMEOUTS.labels(op=op) for op in ("send_to", "recv_from")}
+_ROUND_RETRIES = _REG.counter(
+    "net_round_retries_total",
+    "MPC rounds re-run after a transient transport fault",
+)
+_ROUND_FAILURES = _REG.counter(
+    "net_round_failures_total",
+    "MPC rounds abandoned after exhausting retries",
+)
+
+# The job the current dynamic extent is proving for, threaded by the
+# service layer (service/worker.py) so a transport failure deep inside a
+# collective names the job that died. Contextvars flow into asyncio tasks
+# and to_thread, so one `with job_context(id):` around the round suffices.
+CURRENT_JOB_ID: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "dg16_job_id", default=None
+)
+
+
+@contextmanager
+def job_context(job_id: str | None):
+    """Label every MpcNetError raised in this extent with `job_id`."""
+    token = CURRENT_JOB_ID.set(job_id)
+    try:
+        yield
+    finally:
+        CURRENT_JOB_ID.reset(token)
+
 
 class MpcNetError(RuntimeError):
     """Structured transport failure: names the local party, the peer the
-    op was against, the logical channel, and the collective — so a failed
-    2^20 proving round says *which* socket broke, not just that one did."""
+    op was against, the logical channel, the collective — and, when raised
+    while proving a service job (job_context), the job's correlation id,
+    so a failed 2^20 proving round says *which* socket broke and *which*
+    job died, not just that one did."""
 
     def __init__(
         self,
@@ -66,15 +127,18 @@ class MpcNetError(RuntimeError):
         peer: int | None = None,
         sid: int | None = None,
         op: str | None = None,
+        job_id: str | None = None,
     ):
         self.party = party
         self.peer = peer
         self.sid = sid
         self.op = op
+        self.job_id = job_id if job_id is not None else CURRENT_JOB_ID.get()
         ctx = ", ".join(
             f"{k}={v}"
             for k, v in (
-                ("party", party), ("peer", peer), ("sid", sid), ("op", op)
+                ("party", party), ("peer", peer), ("sid", sid), ("op", op),
+                ("job", self.job_id),
             )
             if v is not None
         )
@@ -84,7 +148,8 @@ class MpcNetError(RuntimeError):
     def with_op(self, op: str) -> "MpcNetError":
         """Same failure, re-labelled with the enclosing collective."""
         return type(self)(
-            self.msg, party=self.party, peer=self.peer, sid=self.sid, op=op
+            self.msg, party=self.party, peer=self.peer, sid=self.sid, op=op,
+            job_id=self.job_id,
         )
 
 
@@ -156,62 +221,77 @@ class BaseNet:
         timeout: float | None = None,
     ) -> None:
         t = self._resolve_timeout(timeout)
+        t0 = time.perf_counter()
         try:
             if t is None:
                 await self._send_impl(to, value, sid)
             else:
                 await asyncio.wait_for(self._send_impl(to, value, sid), t)
         except (asyncio.TimeoutError, TimeoutError):
+            _TO["send_to"].inc()
             raise MpcTimeoutError(
                 f"send deadline ({t}s) exceeded",
                 party=self.party_id, peer=to, sid=sid, op="send_to",
             ) from None
+        finally:
+            _COLL["send_to"].observe(time.perf_counter() - t0)
 
     async def recv_from(
         self, frm: int, sid: int = 0, timeout: float | None = None
     ) -> Any:
         t = self._resolve_timeout(timeout)
+        t0 = time.perf_counter()
         try:
             if t is None:
                 return await self._recv_impl(frm, sid)
             return await asyncio.wait_for(self._recv_impl(frm, sid), t)
         except (asyncio.TimeoutError, TimeoutError):
+            _TO["recv_from"].inc()
             raise MpcTimeoutError(
                 f"recv deadline ({t}s) exceeded",
                 party=self.party_id, peer=frm, sid=sid, op="recv_from",
             ) from None
+        finally:
+            _COLL["recv_from"].observe(time.perf_counter() - t0)
 
     async def gather_to_king(
         self, value: Any, sid: int = 0, timeout: float | None = None
     ):
         """King returns [v_0, ..., v_{n-1}] (own value at index 0);
         clients send and return None."""
-        try:
-            if self.is_king:
-                log.debug("gather_to_king: king collecting %d values (sid=%d)",
-                          self.n_parties, sid)
-                out = [value]
-                recvs = [
-                    asyncio.create_task(self.recv_from(i, sid, timeout=timeout))
-                    for i in range(1, self.n_parties)
-                ]
-                try:
-                    out.extend(await asyncio.gather(*recvs))
-                except BaseException:
-                    # reap the sibling recvs: a leaked task would consume
-                    # a healthy peer's NEXT frame and desync later
-                    # collectives (or raise into the void at its deadline)
-                    for t in recvs:
-                        t.cancel()
-                    await asyncio.gather(*recvs, return_exceptions=True)
-                    raise
-                return out
-            log.debug("gather_to_king: party %d sending (sid=%d)",
-                      self.party_id, sid)
-            await self.send_to(0, value, sid, timeout=timeout)
-            return None
-        except MpcNetError as e:
-            raise e.with_op("gather_to_king") from None
+        t0 = time.perf_counter()
+        with _tracing.span("net.gather_to_king", party=self.party_id, sid=sid):
+            try:
+                return await self._gather_impl(value, sid, timeout)
+            except MpcNetError as e:
+                raise e.with_op("gather_to_king") from None
+            finally:
+                _COLL["gather_to_king"].observe(time.perf_counter() - t0)
+
+    async def _gather_impl(self, value, sid, timeout):
+        if self.is_king:
+            log.debug("gather_to_king: king collecting %d values (sid=%d)",
+                      self.n_parties, sid)
+            out = [value]
+            recvs = [
+                asyncio.create_task(self.recv_from(i, sid, timeout=timeout))
+                for i in range(1, self.n_parties)
+            ]
+            try:
+                out.extend(await asyncio.gather(*recvs))
+            except BaseException:
+                # reap the sibling recvs: a leaked task would consume
+                # a healthy peer's NEXT frame and desync later
+                # collectives (or raise into the void at its deadline)
+                for t in recvs:
+                    t.cancel()
+                await asyncio.gather(*recvs, return_exceptions=True)
+                raise
+            return out
+        log.debug("gather_to_king: party %d sending (sid=%d)",
+                  self.party_id, sid)
+        await self.send_to(0, value, sid, timeout=timeout)
+        return None
 
     async def scatter_from_king(
         self, values, sid: int = 0, timeout: float | None = None
@@ -226,29 +306,38 @@ class BaseNet:
                     f"scatter_from_king: {len(values)} values for "
                     f"{self.n_parties} parties"
                 )
-        try:
-            if self.is_king:
-                log.debug("scatter_from_king: king fanning out %d values "
-                          "(sid=%d)", len(values), sid)
-                sends = [
-                    asyncio.create_task(
-                        self.send_to(i, values[i], sid, timeout=timeout)
-                    )
-                    for i in range(1, self.n_parties)
-                ]
-                try:
-                    await asyncio.gather(*sends)
-                except BaseException:
-                    for t in sends:
-                        t.cancel()
-                    await asyncio.gather(*sends, return_exceptions=True)
-                    raise
-                return values[0]
-            if values is not None:
-                raise MpcNetError("scatter_from_king: client must pass None")
-            return await self.recv_from(0, sid, timeout=timeout)
-        except (MpcTimeoutError, MpcDisconnectError) as e:
-            raise e.with_op("scatter_from_king") from None
+        t0 = time.perf_counter()
+        with _tracing.span(
+            "net.scatter_from_king", party=self.party_id, sid=sid
+        ):
+            try:
+                return await self._scatter_impl(values, sid, timeout)
+            except (MpcTimeoutError, MpcDisconnectError) as e:
+                raise e.with_op("scatter_from_king") from None
+            finally:
+                _COLL["scatter_from_king"].observe(time.perf_counter() - t0)
+
+    async def _scatter_impl(self, values, sid, timeout):
+        if self.is_king:
+            log.debug("scatter_from_king: king fanning out %d values "
+                      "(sid=%d)", len(values), sid)
+            sends = [
+                asyncio.create_task(
+                    self.send_to(i, values[i], sid, timeout=timeout)
+                )
+                for i in range(1, self.n_parties)
+            ]
+            try:
+                await asyncio.gather(*sends)
+            except BaseException:
+                for t in sends:
+                    t.cancel()
+                await asyncio.gather(*sends, return_exceptions=True)
+                raise
+            return values[0]
+        if values is not None:
+            raise MpcNetError("scatter_from_king: client must pass None")
+        return await self.recv_from(0, sid, timeout=timeout)
 
     async def king_compute(
         self,
@@ -258,9 +347,14 @@ class BaseNet:
         timeout: float | None = None,
     ):
         """gather -> f on king -> scatter (MpcNet::king_compute)."""
-        gathered = await self.gather_to_king(value, sid, timeout=timeout)
-        out = f(gathered) if gathered is not None else None
-        return await self.scatter_from_king(out, sid, timeout=timeout)
+        t0 = time.perf_counter()
+        with _tracing.span("net.king_compute", party=self.party_id, sid=sid):
+            try:
+                gathered = await self.gather_to_king(value, sid, timeout=timeout)
+                out = f(gathered) if gathered is not None else None
+                return await self.scatter_from_king(out, sid, timeout=timeout)
+            finally:
+                _COLL["king_compute"].observe(time.perf_counter() - t0)
 
     async def broadcast_from_king(
         self, value: Any, sid: int = 0, timeout: float | None = None
@@ -364,7 +458,9 @@ def run_round_with_retries(
             )
         except (MpcTimeoutError, MpcDisconnectError) as e:
             if attempt == attempts - 1:
+                _ROUND_FAILURES.inc()
                 raise
+            _ROUND_RETRIES.inc()
             log.warning(
                 "round attempt %d/%d failed (%s); retrying",
                 attempt + 1, attempts, e,

@@ -53,6 +53,7 @@ from typing import Any
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import metrics as _tm
 from ..utils import serde
 from ..utils.config import NetConfig
 from .net import (
@@ -67,7 +68,53 @@ from .net import (
 # mpc-net/src/prod.rs); enable via the "distributed_groth16_tpu" logger
 log = logging.getLogger(__name__)
 
+# -- network accounting ------------------------------------------------------
+# Wire-level counters (docs/OBSERVABILITY.md): bytes/frames per peer and
+# logical channel, heartbeat liveness, and fault events. Per-(peer, sid)
+# children are pre-bound at _finish_setup so the frame path pays one dict
+# lookup per send/recv; cold paths (dial retries, deaths) bind inline.
+_REG = _tm.registry()
+_BYTES_TX = _REG.counter(
+    "net_bytes_sent_total", "Frame bytes written, per peer and channel",
+    ("peer", "sid"),
+)
+_BYTES_RX = _REG.counter(
+    "net_bytes_recv_total", "Frame bytes read, per peer and channel",
+    ("peer", "sid"),
+)
+_FRAMES_TX = _REG.counter(
+    "net_frames_sent_total", "Frames written, per peer and channel",
+    ("peer", "sid"),
+)
+_FRAMES_RX = _REG.counter(
+    "net_frames_recv_total", "Frames read, per peer and channel",
+    ("peer", "sid"),
+)
+_HB_SENT = _REG.counter(
+    "net_heartbeats_sent_total", "HEARTBEAT frames written, per peer",
+    ("peer",),
+)
+_PEER_IDLE = _REG.gauge(
+    "net_peer_idle_seconds",
+    "Seconds since the last frame from peer (sampled each heartbeat tick)",
+    ("peer",),
+)
+_RECONNECTS = _REG.counter(
+    "net_reconnects_total", "Client re-dials of the king, per party",
+    ("party",),
+)
+_ERR_FRAMES = _REG.counter(
+    "net_err_frames_total", "ERR death-notice frames received, per peer",
+    ("peer",),
+)
+_PEER_DEATHS = _REG.counter(
+    "net_peer_deaths_total", "Peers declared dead, per peer", ("peer",)
+)
+
 SYN, SYNACK, DATA, HEARTBEAT, ERR = 0, 1, 2, 3, 4
+
+# frame overhead: u32 length prefix + (packet_type, sid) envelope
+_FRAME_OVERHEAD = 6
 
 # Frame-length ceiling: a hostile/corrupt peer must not be able to demand a
 # 4 GB allocation with one u32 header (the reference bounds frames the same
@@ -179,6 +226,12 @@ class ProdNet(BaseNet):
         self._death_reason: dict[int, str] = {}
         self._last_seen: dict[int, float] = {}
         self._closed = False
+        # pre-bound per-(peer, sid) accounting children (populated in
+        # _finish_setup): (bytes, frames) counter pairs per direction
+        self._acct_tx: dict[tuple[int, int], tuple] = {}
+        self._acct_rx: dict[tuple[int, int], tuple] = {}
+        self._acct_hb: dict[int, Any] = {}
+        self._acct_idle: dict[int, Any] = {}
 
     # -- bring-up ------------------------------------------------------------
 
@@ -294,6 +347,7 @@ class ProdNet(BaseNet):
                 if io is not None:
                     await io.close()
                 attempt += 1
+                _RECONNECTS.labels(party=str(party_id)).inc()
                 now = loop.time()
                 if now >= deadline:
                     raise MpcTimeoutError(
@@ -336,8 +390,20 @@ class ProdNet(BaseNet):
     async def _finish_setup(self) -> None:
         loop = asyncio.get_running_loop()
         for peer, io in self._ios.items():
+            p = str(peer)
+            self._acct_hb[peer] = _HB_SENT.labels(peer=p)
+            self._acct_idle[peer] = _PEER_IDLE.labels(peer=p)
             for sid in range(CHANNELS):
                 self._queues[(peer, sid)] = asyncio.Queue()
+                s = str(sid)
+                self._acct_tx[(peer, sid)] = (
+                    _BYTES_TX.labels(peer=p, sid=s),
+                    _FRAMES_TX.labels(peer=p, sid=s),
+                )
+                self._acct_rx[(peer, sid)] = (
+                    _BYTES_RX.labels(peer=p, sid=s),
+                    _FRAMES_RX.labels(peer=p, sid=s),
+                )
             self._last_seen[peer] = loop.time()
             self._pumps.append(asyncio.create_task(self._pump(peer, io)))
             if self.net_cfg.heartbeat_interval_s > 0:
@@ -352,6 +418,15 @@ class ProdNet(BaseNet):
             await self.close()
             raise
 
+    def _account_tx(self, peer: int, sid: int, payload_len: int) -> None:
+        """Count one written frame — every write path must call this so
+        tx and rx accounting reconcile frame-for-frame on a healthy link
+        (the pump counts the receive side)."""
+        acct = self._acct_tx.get((peer, sid))
+        if acct is not None:
+            acct[0].inc(payload_len + _FRAME_OVERHEAD)
+            acct[1].inc()
+
     def _fail_peer(self, peer: int, reason: str, relay: bool = True) -> None:
         """Declare a peer dead: poison every (peer, sid) queue so pending
         AND future recvs raise with the reason, and — king only — relay
@@ -361,6 +436,7 @@ class ProdNet(BaseNet):
             return
         self._dead.add(peer)
         self._death_reason[peer] = reason
+        _PEER_DEATHS.labels(peer=str(peer)).inc()
         log.warning("party %d: stream to peer %d died: %s",
                     self.party_id, peer, reason)
         for sid in range(CHANNELS):
@@ -371,12 +447,14 @@ class ProdNet(BaseNet):
                 if other != peer and other not in self._dead:
                     # tracked so close() can cancel an unflushed relay
                     self._pumps.append(
-                        asyncio.create_task(self._send_err(io, msg))
+                        asyncio.create_task(self._send_err(other, io, msg))
                     )
 
-    async def _send_err(self, io, reason: str) -> None:
+    async def _send_err(self, peer: int, io, reason: str) -> None:
         try:
-            await _send_frame(io, ERR, 0, serde.dumps(reason))
+            payload = serde.dumps(reason)
+            await _send_frame(io, ERR, 0, payload)
+            self._account_tx(peer, 0, len(payload))
         except Exception:  # noqa: BLE001 — best-effort death notice
             pass
 
@@ -390,9 +468,14 @@ class ProdNet(BaseNet):
             while True:
                 ptype, sid, payload = await _recv_frame(io)
                 self._last_seen[peer] = loop.time()
+                acct = self._acct_rx.get((peer, sid))
+                if acct is not None:
+                    acct[0].inc(len(payload) + _FRAME_OVERHEAD)
+                    acct[1].inc()
                 if ptype == HEARTBEAT:
                     continue
                 if ptype == ERR:
+                    _ERR_FRAMES.labels(peer=str(peer)).inc()
                     try:
                         reason = serde.loads(payload)
                     except Exception:  # noqa: BLE001 — reason is best-effort
@@ -420,6 +503,7 @@ class ProdNet(BaseNet):
             if self._closed or peer in self._dead:
                 return
             idle = loop.time() - self._last_seen[peer]
+            self._acct_idle[peer].set(idle)
             if cfg.idle_timeout_s > 0 and idle > cfg.idle_timeout_s:
                 # our own loop may just have resumed from a long
                 # synchronous compute phase with the peer's frames still
@@ -437,6 +521,8 @@ class ProdNet(BaseNet):
                 return
             try:
                 await _send_frame(io, HEARTBEAT, 0, b"")
+                self._acct_hb[peer].inc()
+                self._account_tx(peer, 0, 0)
             except Exception as e:  # noqa: BLE001 — write failure = death
                 self._fail_peer(peer, f"heartbeat write failed: {e}")
                 return
@@ -458,6 +544,7 @@ class ProdNet(BaseNet):
         if self.is_king:
             for peer, io in self._ios.items():
                 await _send_frame(io, SYN, 0, b"")
+                self._account_tx(peer, 0, 0)
             for peer in self._ios:
                 ptype, detail = await self._queues[(peer, 0)].get()
                 if ptype != SYNACK:
@@ -473,6 +560,7 @@ class ProdNet(BaseNet):
                     party=self.party_id, peer=0, op="synchronize",
                 )
             await _send_frame(self._ios[0], SYNACK, 0, b"")
+            self._account_tx(0, 0, 0)
 
     # -- MpcNet surface ------------------------------------------------------
 
@@ -489,7 +577,9 @@ class ProdNet(BaseNet):
                 party=self.party_id, peer=to, sid=sid,
             )
         try:
-            await _send_frame(io, DATA, sid, serde.dumps(_to_wire(value)))
+            payload = serde.dumps(_to_wire(value))
+            await _send_frame(io, DATA, sid, payload)
+            self._account_tx(to, sid, len(payload))
         except (ConnectionError, OSError) as e:
             self._fail_peer(to, f"send failed: {type(e).__name__}: {e}")
             raise MpcDisconnectError(
@@ -528,7 +618,7 @@ class ProdNet(BaseNet):
         for peer, io in self._ios.items():
             if peer not in self._dead:
                 await self._send_err(
-                    io, f"party {self.party_id} aborted: {reason}"
+                    peer, io, f"party {self.party_id} aborted: {reason}"
                 )
         await self.close()
 

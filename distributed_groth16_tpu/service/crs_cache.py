@@ -23,6 +23,18 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable
 
+from ..telemetry import metrics as _tm
+
+# Process-wide counters (docs/OBSERVABILITY.md) — the /metrics view of the
+# per-instance ints below. A process runs one service cache, so summing
+# across instances (tests build throwaways) is the intended semantics.
+_REG = _tm.registry()
+_HITS = _REG.counter("crs_cache_hits_total", "Packed-CRS cache hits")
+_MISSES = _REG.counter("crs_cache_misses_total", "Packed-CRS cache misses")
+_EVICTIONS = _REG.counter(
+    "crs_cache_evictions_total", "Packed-CRS cache LRU evictions"
+)
+
 
 class CrsCache:
     def __init__(self, capacity: int = 8):
@@ -41,18 +53,21 @@ class CrsCache:
         if self.capacity <= 0:
             with self._lock:
                 self.misses += 1
+            _MISSES.inc()
             return factory()
         while True:
             with self._lock:
                 if key in self._data:
                     self._data.move_to_end(key)
                     self.hits += 1
+                    _HITS.inc()
                     return self._data[key]
                 ev = self._pending.get(key)
                 if ev is None:
                     ev = threading.Event()
                     self._pending[key] = ev
                     self.misses += 1
+                    _MISSES.inc()
                     break  # we are the leader
             # follower: wait for the leader, then re-check (a dead leader
             # leaves the key absent and we retry for leadership)
@@ -70,6 +85,7 @@ class CrsCache:
             while len(self._data) > self.capacity:
                 self._data.popitem(last=False)
                 self.evictions += 1
+                _EVICTIONS.inc()
             del self._pending[key]
         ev.set()
         return value

@@ -20,11 +20,13 @@ from __future__ import annotations
 
 import asyncio
 import enum
+import json
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
+from ..telemetry.tracing import TraceBuffer
 from ..utils.timers import PhaseTimings
 
 
@@ -64,6 +66,13 @@ class ProofJob:
     started_at: float | None = None
     finished_at: float | None = None
     timings: PhaseTimings = field(default_factory=PhaseTimings)
+    # per-proof span trace (telemetry/tracing.py): the executor collects
+    # into this while the job runs; GET /jobs/{id} returns it as a span
+    # tree. Bounded so 1024 retained terminal jobs stay cheap.
+    trace: TraceBuffer = field(
+        default_factory=lambda: TraceBuffer(max_events=4096),
+        repr=False, compare=False,
+    )
     result: dict[str, Any] | None = None
     error: dict[str, Any] | None = None
 
@@ -74,6 +83,9 @@ class ProofJob:
         # at phase boundaries — the only cross-thread signal a job carries
         self._cancel_flag = threading.Event()
         self._done = asyncio.Event()
+        # terminal-state trace snapshot (see _finish)
+        self._spans_json: str | None = None
+        self._dropped_spans = 0
 
     # -- executor-side hooks (worker thread) --------------------------------
 
@@ -112,6 +124,12 @@ class ProofJob:
         # is dead weight once the job is terminal — drop it so retained
         # terminal jobs cost registry metadata, not upload-sized buffers
         self.fields = {}
+        # likewise the raw trace events: up to 4096 dicts per job across
+        # 1024 retained jobs is hundreds of MB of Python objects. Compact
+        # the span tree to one JSON string (tens of KB) and drop them.
+        self._dropped_spans = self.trace.dropped
+        self._spans_json = json.dumps(self.trace.span_tree())
+        self.trace.clear()
         self._done.set()
 
     async def wait(self) -> "ProofJob":
@@ -137,6 +155,17 @@ class ProofJob:
             "startedAt": self.started_at,
             "finishedAt": self.finished_at,
             "phases": self.timings.as_millis(),
+            "metrics": (
+                {
+                    "spans": json.loads(self._spans_json),
+                    "droppedSpans": self._dropped_spans,
+                }
+                if self._spans_json is not None
+                else {
+                    "spans": self.trace.span_tree(),
+                    "droppedSpans": self.trace.dropped,
+                }
+            ),
         }
         if self.error is not None:
             out["error"] = self.error

@@ -21,8 +21,33 @@ import asyncio
 import math
 from collections import deque
 
+from ..telemetry import metrics as _tm
 from ..utils.timers import PhaseTimings
 from .jobs import JobState, ProofJob
+
+# Queue-shape metrics (docs/OBSERVABILITY.md). Process-wide like the rest
+# of the registry: a process runs one service, so queue gauges are global.
+_REG = _tm.registry()
+_SUBMITTED = _REG.counter("jobs_submitted_total", "Jobs admitted to the queue")
+_REJECTED = _REG.counter(
+    "jobs_rejected_total", "Jobs rejected at the admission bound (HTTP 429)"
+)
+_FINISHED = _REG.counter(
+    "jobs_finished_total", "Jobs reaching a terminal state", ("state",)
+)
+_DEPTH = _REG.gauge("job_queue_depth", "Jobs currently waiting (QUEUED)")
+_RUNNING = _REG.gauge("job_queue_running", "Jobs currently executing")
+_RUNTIME_EMA = _REG.gauge(
+    "job_runtime_ema_seconds",
+    "Exponential moving average of job runtime — the retryAfter estimator",
+)
+_QUEUE_WAIT = _REG.histogram(
+    "job_queue_wait_seconds", "Seconds a job waited QUEUED before starting"
+)
+_JOB_SECONDS = _REG.histogram(
+    "job_seconds", "End-to-end job runtime (RUNNING to terminal), per kind",
+    ("kind",),
+)
 
 
 class QueueFullError(Exception):
@@ -73,11 +98,14 @@ class JobQueue:
         depth = len(self._queued_ids)
         if depth >= self.bound:
             self.rejected += 1
+            _REJECTED.inc()
             raise QueueFullError(self.bound, depth, self.retry_after_hint())
         self.jobs[job.id] = job
         self._queued_ids.add(job.id)
         self._q.put_nowait(job)
         self.submitted += 1
+        _SUBMITTED.inc()
+        _DEPTH.set(len(self._queued_ids))
         return job
 
     def retry_after_hint(self) -> float:
@@ -94,19 +122,25 @@ class JobQueue:
     async def get(self) -> ProofJob:
         job = await self._q.get()
         self._queued_ids.discard(job.id)
+        _DEPTH.set(len(self._queued_ids))
         return job
 
     def on_started(self, job: ProofJob) -> None:
         self._running_ids.add(job.id)
+        _RUNNING.set(len(self._running_ids))
+        if job.started_at is not None:
+            _QUEUE_WAIT.observe(job.started_at - job.created_at)
 
     def on_finished(self, job: ProofJob) -> None:
         self._running_ids.discard(job.id)
+        _RUNNING.set(len(self._running_ids))
         if job.state is JobState.DONE:
             self.completed += 1
         elif job.state is JobState.FAILED:
             self.failed += 1
         elif job.state is JobState.CANCELLED:
             self.cancelled += 1
+        _FINISHED.labels(state=job.state.value).inc()
         rt = job.runtime_s
         if rt is not None:
             self._runtime_ema_s = (
@@ -114,6 +148,8 @@ class JobQueue:
                 if self._runtime_ema_s is None
                 else 0.7 * self._runtime_ema_s + 0.3 * rt
             )
+            _RUNTIME_EMA.set(self._runtime_ema_s)
+            _JOB_SECONDS.labels(kind=job.kind).observe(rt)
         self.aggregate_timings.merge(job.timings)
         self._note_terminal(job)
 
@@ -138,6 +174,7 @@ class JobQueue:
             self._queued_ids.discard(job.id)
             if job.state is JobState.QUEUED:
                 out.append(job)
+        _DEPTH.set(len(self._queued_ids))
         return out
 
     # -- control plane -------------------------------------------------------
@@ -152,9 +189,11 @@ class JobQueue:
             return None
         if job.state is JobState.QUEUED:
             self._queued_ids.discard(job.id)
+            _DEPTH.set(len(self._queued_ids))
             job.request_cancel()
             job.mark_cancelled()
             self.cancelled += 1
+            _FINISHED.labels(state=JobState.CANCELLED.value).inc()
             self._note_terminal(job)
         elif job.state is JobState.RUNNING:
             job.request_cancel()
@@ -171,6 +210,9 @@ class JobQueue:
             "completed": self.completed,
             "failed": self.failed,
             "cancelled": self.cancelled,
+            # the runtime EMA feeding retry_after_hint, exposed both here
+            # and as the job_runtime_ema_seconds gauge on /metrics; None
+            # until the first job completes (cold start)
             "meanRuntimeS": self._runtime_ema_s,
             "phases": self.aggregate_timings.as_millis(),
         }

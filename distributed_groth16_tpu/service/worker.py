@@ -30,8 +30,9 @@ from ..models.groth16 import (
 )
 from ..models.groth16.prove import prove_single
 from ..ops.field import fr
-from ..parallel.net import run_round_with_retries
+from ..parallel.net import job_context, run_round_with_retries
 from ..parallel.pss import PackedSharingParams
+from ..telemetry import tracing
 from ..utils.config import ServiceConfig
 from ..utils.timers import phase
 from .crs_cache import CrsCache
@@ -104,6 +105,17 @@ class ProofExecutor:
     # -- the proving path ----------------------------------------------------
 
     def run(self, job: ProofJob) -> dict:
+        """Executor entry: every span below lands in the job's own trace
+        buffer (GET /jobs/{id} metrics block — and DG16_TRACE_OUT, if
+        set), and any transport failure inside the MPC round carries the
+        job id (net.job_context -> MpcNetError.job_id)."""
+        with tracing.collect(job.trace), job_context(job.id), tracing.span(
+            "job", job=job.id, attrs={"kind": job.kind,
+                                      "circuit": job.circuit_id},
+        ):
+            return self._run(job)
+
+    def _run(self, job: ProofJob) -> dict:
         timings = job.timings
         with phase("load", timings):
             r1cs, pk = self.store.load(job.circuit_id)

@@ -1,0 +1,9 @@
+"""Telemetry spine: the process-wide metrics registry (metrics.py) and
+span tracing with Chrome trace-event export (tracing.py). Every layer —
+transport, distributed kernels, prover, service, API, bench — records
+through here; docs/OBSERVABILITY.md is the catalog and naming convention.
+"""
+
+from . import metrics, tracing  # noqa: F401
+from .metrics import registry  # noqa: F401
+from .tracing import TraceBuffer, collect, span  # noqa: F401

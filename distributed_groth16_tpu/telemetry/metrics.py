@@ -1,0 +1,353 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+The observability spine's numeric half (docs/OBSERVABILITY.md). Design
+constraints, in order:
+
+  1. Hot-path cost. The star collectives call into this once per op at
+     2^20 scale, so a recorded sample must cost one dict lookup plus an
+     in-place add — no per-call allocations. Call sites pre-bind label
+     children (`family.labels(op="gather_to_king")`) once and hold the
+     child; `child.inc()` / `child.observe()` is then lock + add.
+  2. Process-wide. One registry per process (the Prometheus model): every
+     layer registers its families at import time, so `GET /metrics` and
+     bench.py see one coherent snapshot without plumbing a registry handle
+     through twelve constructors. `registry()` returns it; tests compare
+     deltas, never absolute values.
+  3. Thread-safe. Worker threads, the event loop, and the bench watchdog
+     all record concurrently; every family carries an RLock (re-entrant so
+     a signal handler snapshotting mid-increment cannot deadlock bench's
+     SIGTERM emit path).
+
+Exposition is Prometheus text format 0.0.4 (`render_prometheus`), with
+HELP/TYPE lines for every registered family — a family with no recorded
+series is still discoverable by scrapers. `DG16_METRICS=0` turns every
+record call into an early return (the kill switch; collection is on by
+default because it is allocation-free).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from bisect import bisect_left
+from typing import Sequence
+
+INF = float("inf")
+
+# latency buckets wide enough for both a microseconds-scale in-process
+# collective and a minutes-scale million-constraint proof phase
+DEFAULT_TIME_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, INF,
+)
+
+_ENABLED = os.environ.get("DG16_METRICS", "1").lower() not in ("0", "false")
+
+
+def set_enabled(on: bool) -> None:
+    """Flip collection globally (the DG16_METRICS knob, testable)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if v == INF:
+        return "+Inf"
+    if v == -INF:
+        return "-Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _series(name: str, labelnames: tuple, labelvalues: tuple) -> str:
+    if not labelnames:
+        return name
+    inner = ",".join(
+        f'{n}="{_escape_label(v)}"'
+        for n, v in zip(labelnames, labelvalues)
+    )
+    return f"{name}{{{inner}}}"
+
+
+class _Counter:
+    """Monotonic counter child (one label combination)."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.RLock):
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self.value += n
+
+
+class _Gauge:
+    """Set-to-current-value child."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.RLock):
+        self._lock = lock
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self.value = v
+
+    def inc(self, n: float = 1.0) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+
+class _Histogram:
+    """Fixed-bucket histogram child: per-bucket counts + sum + count."""
+
+    __slots__ = ("_lock", "_bounds", "counts", "sum", "count")
+
+    def __init__(self, lock: threading.RLock, bounds: tuple):
+        self._lock = lock
+        self._bounds = bounds
+        self.counts = [0] * len(bounds)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self.counts[bisect_left(self._bounds, v)] += 1
+            self.sum += v
+            self.count += 1
+
+
+class _Family:
+    """One named metric with a fixed label dimension; children per label
+    combination. `labels()` is get-or-create and returns the same child
+    object for the same values — bind it once on hot paths."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str]):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.RLock()
+        self._children: dict[tuple, object] = {}
+        self._default = self._child() if not self.labelnames else None
+
+    def _child(self):
+        raise NotImplementedError
+
+    def labels(self, *values, **kw):
+        if kw:
+            if values or set(kw) != set(self.labelnames):
+                raise ValueError(
+                    f"{self.name}: labels {sorted(kw)} != "
+                    f"{list(self.labelnames)}"
+                )
+            values = tuple(str(kw[n]) for n in self.labelnames)
+        else:
+            if len(values) != len(self.labelnames):
+                raise ValueError(
+                    f"{self.name}: {len(values)} label values for "
+                    f"{len(self.labelnames)} label names"
+                )
+            values = tuple(str(v) for v in values)
+        with self._lock:
+            c = self._children.get(values)
+            if c is None:
+                c = self._children[values] = self._child()
+            return c
+
+    def _items(self) -> list[tuple[tuple, object]]:
+        with self._lock:
+            if self._default is not None:
+                return [((), self._default)]
+            return sorted(self._children.items())
+
+
+class CounterFamily(_Family):
+    kind = "counter"
+
+    def _child(self):
+        return _Counter(self._lock)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._default.inc(n)
+
+    @property
+    def value(self) -> float:
+        return self._default.value
+
+
+class GaugeFamily(_Family):
+    kind = "gauge"
+
+    def _child(self):
+        return _Gauge(self._lock)
+
+    def set(self, v: float) -> None:
+        self._default.set(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._default.inc(n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._default.dec(n)
+
+    @property
+    def value(self) -> float:
+        return self._default.value
+
+
+class HistogramFamily(_Family):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames, buckets=DEFAULT_TIME_BUCKETS):
+        b = tuple(float(x) for x in buckets)
+        if not b or b[-1] != INF:
+            b = b + (INF,)
+        if list(b) != sorted(b):
+            raise ValueError(f"{name}: buckets must be sorted")
+        self.buckets = b
+        super().__init__(name, help, labelnames)
+
+    def _child(self):
+        return _Histogram(self._lock, self.buckets)
+
+    def observe(self, v: float) -> None:
+        self._default.observe(v)
+
+
+class MetricsRegistry:
+    """Name -> family map; get-or-create is idempotent so every module can
+    declare its families at import time in any order. Re-registering a
+    name with a different type, label set, or bucket layout is a bug and
+    raises."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: dict[str, _Family] = {}
+
+    def _get(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if type(fam) is not cls or fam.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}{fam.labelnames}"
+                    )
+                if kw.get("buckets") is not None and tuple(
+                    float(x) for x in kw["buckets"]
+                ) not in (fam.buckets, fam.buckets[:-1]):
+                    raise ValueError(
+                        f"metric {name!r} re-registered with different buckets"
+                    )
+                return fam
+            fam = cls(name, help, labelnames, **{
+                k: v for k, v in kw.items() if v is not None
+            })
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name, help="", labelnames=()) -> CounterFamily:
+        return self._get(CounterFamily, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> GaugeFamily:
+        return self._get(GaugeFamily, name, help, labelnames)
+
+    def histogram(
+        self, name, help="", labelnames=(), buckets=None
+    ) -> HistogramFamily:
+        return self._get(
+            HistogramFamily, name, help, labelnames, buckets=buckets
+        )
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat {series: value} map (histograms as _sum/_count) — the
+        bench.py JSON-line and /stats shape."""
+        out: dict[str, float] = {}
+        with self._lock:
+            fams = list(self._families.values())
+        for fam in fams:
+            for values, child in fam._items():
+                s = _series(fam.name, fam.labelnames, values)
+                if isinstance(child, _Histogram):
+                    if child.count:
+                        out[
+                            _series(fam.name + "_sum", fam.labelnames, values)
+                        ] = child.sum
+                        out[
+                            _series(fam.name + "_count", fam.labelnames, values)
+                        ] = float(child.count)
+                elif isinstance(child, _Gauge) or child.value:
+                    out[s] = child.value
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            fams = sorted(self._families.values(), key=lambda f: f.name)
+        for fam in fams:
+            lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for values, child in fam._items():
+                if isinstance(child, _Histogram):
+                    cum = 0
+                    for bound, n in zip(fam.buckets, child.counts):
+                        cum += n
+                        lines.append(
+                            _series(
+                                fam.name + "_bucket",
+                                fam.labelnames + ("le",),
+                                values + (_fmt(bound),),
+                            )
+                            + f" {cum}"
+                        )
+                    lines.append(
+                        _series(fam.name + "_sum", fam.labelnames, values)
+                        + f" {_fmt(child.sum)}"
+                    )
+                    lines.append(
+                        _series(fam.name + "_count", fam.labelnames, values)
+                        + f" {child.count}"
+                    )
+                else:
+                    lines.append(
+                        _series(fam.name, fam.labelnames, values)
+                        + f" {_fmt(child.value)}"
+                    )
+        return "\n".join(lines) + "\n"
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry every layer records into."""
+    return _REGISTRY

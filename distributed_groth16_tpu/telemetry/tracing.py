@@ -1,0 +1,288 @@
+"""Lightweight nested spans + Chrome trace-event export.
+
+The observability spine's timeline half (docs/OBSERVABILITY.md): a span is
+a named wall-clock scope (`with span("prove.A", party=net.party_id): ...`)
+that nests via a contextvar — so the parent chain survives asyncio task
+fan-out (tasks copy the context at creation) and `asyncio.to_thread` /
+`asyncio.run` boundaries, which is exactly the shape of a distributed
+proof: service worker thread -> in-process MPC round -> per-party tasks ->
+per-channel collectives.
+
+Recording targets, in precedence order (a span records into every active
+one):
+
+  * a per-proof `TraceBuffer` installed with `collect(buf)` — the service
+    layer gives each job its own, surfaced as the span tree in
+    `GET /jobs/{id}`;
+  * the process-global buffer enabled by `DG16_TRACE_OUT=trace.json` (or
+    `enable_global(path)` / million.py's `--trace-out`), dumped as Chrome
+    trace-event JSON at exit (atexit) or via `flush_global()` — open it in
+    chrome://tracing or Perfetto and the whole proof renders as a
+    timeline, one track per (party, task).
+
+Zero overhead when idle: with no buffer installed and no `timings` sink,
+`span()` returns a shared no-op singleton — no allocation, no clock read.
+Keyword args are fixed parameters (not **kwargs) for the same reason.
+Events use the complete-event form (`"ph": "X"`) with perf_counter
+microsecond timestamps; `pid` is the MPC party id, `tid` the asyncio task
+(or OS thread), so concurrent parties and overlapped channels land on
+separate tracks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import itertools
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+
+_CURRENT: ContextVar["Span | None"] = ContextVar("dg16_span", default=None)
+_BUFFER: ContextVar["TraceBuffer | None"] = ContextVar(
+    "dg16_trace_buffer", default=None
+)
+_IDS = itertools.count(1)
+
+_global_buffer: "TraceBuffer | None" = None
+_global_path: str | None = None
+
+
+class TraceBuffer:
+    """Bounded, thread-safe sink of finished span events (dicts in Chrome
+    trace-event form). Overflow drops (counted) rather than grows — a
+    runaway span source must not OOM a long-lived service."""
+
+    def __init__(self, max_events: int = 65536):
+        self.max_events = max_events
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self.dropped = 0
+
+    def add(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) < self.max_events:
+                self._events.append(ev)
+            else:
+                self.dropped += 1
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def chrome_trace(self) -> dict:
+        """The chrome://tracing / Perfetto JSON object."""
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+
+    def span_tree(self) -> list[dict]:
+        """Nest finished spans by parent id — the `metrics.spans` block of
+        GET /jobs/{id}. A span whose parent was dropped (overflow) or is
+        still open becomes a root."""
+        evs = self.events()
+        nodes: dict[int, dict] = {}
+        for ev in evs:
+            args = ev.get("args", {})
+            node = {
+                "name": ev["name"],
+                "startUs": ev["ts"],
+                "durUs": ev["dur"],
+                "children": [],
+            }
+            extra = {
+                k: v for k, v in args.items() if k not in ("id", "parent")
+            }
+            if extra:
+                node["attrs"] = extra
+            nodes[args.get("id", 0)] = (node)
+        roots: list[dict] = []
+        for ev in evs:
+            args = ev.get("args", {})
+            node = nodes[args.get("id", 0)]
+            parent = nodes.get(args.get("parent", 0))
+            (parent["children"] if parent is not None else roots).append(node)
+        for node in nodes.values():
+            node["children"].sort(key=lambda n: n["startUs"])
+        roots.sort(key=lambda n: n["startUs"])
+        return roots
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP = _NoopSpan()
+
+
+def _tid() -> int:
+    try:
+        task = asyncio.current_task()
+    except RuntimeError:
+        task = None
+    if task is not None:
+        return id(task) % 1_000_000
+    return threading.get_ident() % 1_000_000
+
+
+class Span:
+    __slots__ = (
+        "name", "bufs", "timings", "pid", "attrs",
+        "id", "parent_id", "_token", "t0",
+    )
+
+    def __init__(self, name, bufs, timings, pid, attrs):
+        self.name = name
+        self.bufs = bufs
+        self.timings = timings
+        self.pid = pid
+        self.attrs = attrs
+        self.id = next(_IDS)
+        self.parent_id = 0
+        self._token = None
+        self.t0 = 0.0
+
+    def __enter__(self):
+        parent = _CURRENT.get()
+        if parent is not None:
+            self.parent_id = parent.id
+            if self.pid is None:
+                self.pid = parent.pid
+        self._token = _CURRENT.set(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, etype, evalue, tb):
+        dt = time.perf_counter() - self.t0
+        _CURRENT.reset(self._token)
+        if self.timings is not None:
+            self.timings.record(self.name, dt)
+        if self.bufs:
+            args = {"id": self.id, "parent": self.parent_id}
+            if self.attrs:
+                args.update(self.attrs)
+            if etype is not None:
+                args["error"] = etype.__name__
+            ev = {
+                "name": self.name,
+                "ph": "X",
+                "ts": round(self.t0 * 1e6, 1),
+                "dur": round(dt * 1e6, 1),
+                "pid": self.pid if self.pid is not None else 0,
+                "tid": _tid(),
+                "args": args,
+            }
+            for buf in self.bufs:
+                buf.add(ev)
+        return False
+
+
+def span(
+    name: str,
+    *,
+    timings=None,
+    party: int | None = None,
+    sid: int | None = None,
+    job: str | None = None,
+    attrs: dict | None = None,
+):
+    """Open a span. `timings` is an optional PhaseTimings-shaped sink
+    (`record(name, seconds)`) written on exit — utils.timers.phase rides
+    on this, making PhaseTimings a view over span data. Returns a shared
+    no-op when no buffer is active and no sink was given."""
+    b = _BUFFER.get()
+    g = _global_buffer
+    if b is None and g is None:
+        if timings is None:
+            return NOOP
+        bufs = ()
+    elif b is None:
+        bufs = (g,)
+    elif g is None or g is b:
+        bufs = (b,)
+    else:
+        bufs = (b, g)
+    a = attrs
+    if sid is not None or job is not None:
+        a = dict(attrs) if attrs else {}
+        if sid is not None:
+            a["sid"] = sid
+        if job is not None:
+            a["job"] = job
+    return Span(name, bufs, timings, party, a)
+
+
+def active() -> bool:
+    """True when at least one buffer would record spans."""
+    return _BUFFER.get() is not None or _global_buffer is not None
+
+
+@contextmanager
+def collect(buffer: TraceBuffer):
+    """Route spans in this dynamic extent (including tasks and threads
+    spawned inside it) into `buffer` — the per-proof trace hook."""
+    token = _BUFFER.set(buffer)
+    try:
+        yield buffer
+    finally:
+        _BUFFER.reset(token)
+
+
+def enable_global(
+    path: str | None = None, max_events: int = 262144
+) -> TraceBuffer:
+    """Install the process-global buffer (the DG16_TRACE_OUT / --trace-out
+    path); returns it. `flush_global()` or process exit writes the file."""
+    global _global_buffer, _global_path
+    if _global_buffer is None:
+        _global_buffer = TraceBuffer(max_events=max_events)
+    if path:
+        _global_path = path
+    return _global_buffer
+
+
+def disable_global() -> None:
+    global _global_buffer, _global_path
+    _global_buffer = None
+    _global_path = None
+
+
+def flush_global(path: str | None = None) -> str | None:
+    """Dump the global buffer as Chrome trace JSON; returns the path
+    written (None when there is nothing to write)."""
+    p = path or _global_path
+    if _global_buffer is None or not p:
+        return None
+    _global_buffer.dump(p)
+    return p
+
+
+def configure_from_env() -> None:
+    """Honor DG16_TRACE_OUT: install the global buffer pointed at it."""
+    path = os.environ.get("DG16_TRACE_OUT", "")
+    if path:
+        enable_global(path)
+
+
+configure_from_env()
+atexit.register(flush_global)

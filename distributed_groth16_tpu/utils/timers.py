@@ -6,6 +6,13 @@ groth16/examples/sha256.rs:42-91) and reports `time_taken` in API responses
 (common/src/dto/mod.rs:53-55). Here: a context manager + registry, gated by
 the DG16_TRACE env var (the RUST_LOG analog), with structured access so the
 service layer can report per-phase timings.
+
+Since the telemetry subsystem landed, `phase()` is a thin wrapper over
+`telemetry.tracing.span()`: the span records into the given PhaseTimings
+on exit, so PhaseTimings is a *view over span data* rather than a parallel
+timing system — a phase shows up in the per-proof trace timeline, the
+`job_phase_seconds{phase=}` histogram, and the legacy phase map from one
+clock read. See docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
@@ -17,7 +24,16 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from ..telemetry import metrics as _metrics
+from ..telemetry import tracing as _tracing
+
 log = logging.getLogger("distributed_groth16_tpu")
+
+_JOB_PHASE_SECONDS = _metrics.registry().histogram(
+    "job_phase_seconds",
+    "Wall-clock seconds of one recorded proof phase",
+    ("phase",),
+)
 
 
 def trace_enabled() -> bool:
@@ -25,14 +41,35 @@ def trace_enabled() -> bool:
 
 
 def _emit(msg: str, *args) -> None:
-    """INFO log, falling back to stderr print when logging is unconfigured
-    (DG16_TRACE should always be visible, config or not)."""
-    if logging.getLogger().handlers or log.handlers:
-        log.info(msg, *args)
-    else:
-        import sys
+    """Exactly-once INFO log, falling back to stderr print when logging is
+    unconfigured (DG16_TRACE should always be visible, config or not).
 
-        print(msg % args, file=sys.stderr, flush=True)
+    When BOTH the package logger and the root logger have handlers,
+    `log.info` would print twice (once via the package handlers, once via
+    propagation to root) — in that case the package handlers win and the
+    record is handed to them directly, bypassing propagation. If every
+    package handler rejects the record (level), fall through to the normal
+    path: they reject it there too and root prints it once."""
+    root = logging.getLogger()
+    if log.handlers and log.propagate and root.handlers:
+        if not log.isEnabledFor(logging.INFO):
+            return
+        record = log.makeRecord(
+            log.name, logging.INFO, __file__, 0, msg, args, None
+        )
+        if not log.filter(record):
+            return
+        eligible = [h for h in log.handlers if record.levelno >= h.level]
+        if eligible:
+            for h in eligible:
+                h.handle(record)
+            return
+    if log.handlers or root.handlers:
+        log.info(msg, *args)
+        return
+    import sys
+
+    print(msg % args, file=sys.stderr, flush=True)
 
 
 @dataclass
@@ -72,16 +109,19 @@ class PhaseTimings:
 
 @contextmanager
 def phase(name: str, timings: PhaseTimings | None = None):
-    """with phase("Compute A"): ... — prints when DG16_TRACE is set and
-    records into `timings` when given."""
-    t0 = time.perf_counter()
-    if trace_enabled():
+    """with phase("Compute A"): ... — prints when DG16_TRACE is set,
+    records into `timings` when given (via the span's exit hook), and
+    shows up as a span on any active trace buffer."""
+    emit = trace_enabled()
+    if emit:
         _emit("Start: %s", name)
+    t0 = time.perf_counter()
     try:
-        yield
+        with _tracing.span(name, timings=timings):
+            yield
     finally:
         dt = time.perf_counter() - t0
         if timings is not None:
-            timings.record(name, dt)
-        if trace_enabled():
+            _JOB_PHASE_SECONDS.labels(phase=name).observe(dt)
+        if emit:
             _emit("End: %s — %.3f ms", name, dt * 1e3)

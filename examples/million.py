@@ -28,7 +28,17 @@ def main() -> int:
                    help="re-run the MPC round up to this many times on a "
                         "transient transport fault (MpcNetError) instead "
                         "of losing the whole proof")
+    p.add_argument("--trace-out", default=None,
+                   help="write a Chrome trace-event JSON timeline of the "
+                        "proof (open in chrome://tracing or Perfetto); "
+                        "DG16_TRACE_OUT is the env equivalent "
+                        "(docs/OBSERVABILITY.md)")
     args = p.parse_args()
+
+    if args.trace_out:
+        from distributed_groth16_tpu.telemetry import tracing
+
+        tracing.enable_global(args.trace_out)
 
     from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
     from distributed_groth16_tpu.models.groth16 import (
@@ -72,6 +82,10 @@ def main() -> int:
         print("phase timings (ms):")
         for k, v in timings.as_millis().items():
             print(f"  {k:30s} {v:12.1f}")
+        if args.trace_out:
+            from distributed_groth16_tpu.telemetry import tracing
+
+            print(f"trace written to {tracing.flush_global()}")
         return 0 if ok else 1
 
     pp = PackedSharingParams(args.l)
@@ -121,6 +135,10 @@ def main() -> int:
     print("phase timings (ms):")
     for k, v in timings.as_millis().items():
         print(f"  {k:30s} {v:12.1f}")
+    if args.trace_out:
+        from distributed_groth16_tpu.telemetry import tracing
+
+        print(f"trace written to {tracing.flush_global()}")
     return 0 if ok else 1
 
 

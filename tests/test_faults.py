@@ -6,6 +6,11 @@ structured MpcNetError naming the offending party — within its deadline,
 never hanging. Each async body is bounded by an outer asyncio.wait_for so
 a regression shows up as a test failure, not a wedged suite.
 
+Since the telemetry subsystem landed, key scenarios also assert the fault
+COUNTERS increment (net_timeouts_total, net_peer_deaths_total,
+net_err_frames_total, net_round_retries_total — docs/OBSERVABILITY.md):
+the counters are process-lifetime, so every check compares deltas.
+
 FaultyIO write indices are deterministic here because the test NetConfig
 disables heartbeats: a client's write #0 is its SYNACK, so DATA frames
 start at write #1 (see faults.py docstring).
@@ -24,7 +29,16 @@ from distributed_groth16_tpu.parallel.net import (
     run_round_with_retries,
 )
 from distributed_groth16_tpu.parallel.prodnet import ChannelIO, ProdNet
+from distributed_groth16_tpu.telemetry import metrics as telemetry_metrics
 from distributed_groth16_tpu.utils.config import NetConfig
+
+
+def _counter(name: str, **labels) -> float:
+    """Current value of a registry counter (0.0 if the series is new)."""
+    fam = telemetry_metrics.registry().counter(
+        name, labelnames=tuple(labels)
+    )
+    return (fam.labels(**labels) if labels else fam).value
 
 # fast deadlines, no heartbeats: deterministic frame indices for FaultyIO
 FAST = NetConfig(
@@ -83,6 +97,8 @@ async def _sum_ids(nets, timeout=None):
 
 
 def test_recv_deadline_raises_structured_timeout():
+    before = _counter("net_timeouts_total", op="recv_from")
+
     async def run():
         nets = await _channel_star(2)
         t0 = time.monotonic()
@@ -94,6 +110,7 @@ def test_recv_deadline_raises_structured_timeout():
         await _close_all(nets)
 
     _bounded(run())
+    assert _counter("net_timeouts_total", op="recv_from") == before + 1
 
 
 def test_gather_deadline_names_silent_party():
@@ -250,6 +267,9 @@ def test_mid_collective_disconnect_both_sides_fail_clean():
 
 
 def test_abort_relays_death_to_other_clients():
+    err_before = _counter("net_err_frames_total", peer="1")
+    deaths_before = _counter("net_peer_deaths_total", peer="1")
+
     async def run():
         nets = await _channel_star(4)
         king, c1, c2, c3 = nets
@@ -268,6 +288,9 @@ def test_abort_relays_death_to_other_clients():
         await _close_all(nets)
 
     _bounded(run())
+    # the king counted party 1's ERR frame and declared it dead
+    assert _counter("net_err_frames_total", peer="1") == err_before + 1
+    assert _counter("net_peer_deaths_total", peer="1") >= deaths_before + 1
 
 
 def test_failed_gather_reaps_sibling_recvs():
@@ -465,6 +488,7 @@ def test_round_retry_recovers_from_transient_fault():
         )
 
     retried = []
+    retries_before = _counter("net_round_retries_total")
     out = run_round_with_retries(
         3, party, retries=2, net_cfg=FAST,
         on_retry=lambda a, e: retried.append((a, str(e))),
@@ -472,14 +496,17 @@ def test_round_retry_recovers_from_transient_fault():
     assert out == [3] * 3
     assert state["round"] == 2
     assert len(retried) == 1 and "transient" in retried[0][1]
+    assert _counter("net_round_retries_total") == retries_before + 1
 
 
 def test_round_retry_exhaustion_propagates():
     async def party(net, _):
         raise MpcDisconnectError("permanently dead", party=net.party_id)
 
+    failures_before = _counter("net_round_failures_total")
     with pytest.raises(MpcDisconnectError):
         run_round_with_retries(2, party, retries=1, net_cfg=FAST)
+    assert _counter("net_round_failures_total") == failures_before + 1
 
 
 def test_round_retry_does_not_swallow_application_errors():
