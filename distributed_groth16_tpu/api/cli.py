@@ -20,6 +20,7 @@ Usage:
   python -m distributed_groth16_tpu.api.cli job status --job-id JOB
   python -m distributed_groth16_tpu.api.cli job watch --job-id JOB \
       [--interval 2] [--out proof.bin]
+  python -m distributed_groth16_tpu.api.cli trace JOB [--out trace.json]
   python -m distributed_groth16_tpu.api.cli metrics
 
 Queue-full submissions (HTTP 429) exit with the server's retryAfter hint
@@ -151,6 +152,23 @@ def cmd_job_watch(args) -> dict:
     return result
 
 
+def cmd_trace(args) -> dict:
+    """GET /jobs/{id}/trace — fetch a job's Chrome trace-event JSON and
+    write it to --out (default trace-<jobId>.json); open the file in
+    chrome://tracing or Perfetto (docs/OBSERVABILITY.md)."""
+    trace = _body(
+        requests.get(f"{args.url}/jobs/{args.job_id}/trace", timeout=600)
+    )
+    out = args.out or f"trace-{args.job_id}.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    return {
+        "jobId": args.job_id,
+        "out": out,
+        "events": len(trace.get("traceEvents", [])),
+    }
+
+
 def cmd_metrics(args) -> dict:
     """GET /metrics — print the server's Prometheus text exposition
     verbatim (pipe into promtool or grep; docs/OBSERVABILITY.md)."""
@@ -218,6 +236,15 @@ def main(argv=None) -> None:
     sp.add_argument("--interval", type=float, default=2.0)
     sp.add_argument("--out", default=None, help="write proof bytes here")
     sp.set_defaults(fn=cmd_job_watch)
+
+    sp = sub.add_parser(
+        "trace",
+        help="fetch a job's merged Chrome trace (GET /jobs/{id}/trace)",
+    )
+    sp.add_argument("job_id", help="job id from `job submit`")
+    sp.add_argument("--out", default=None,
+                    help="output path (default trace-<jobId>.json)")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser(
         "metrics", help="dump the server's /metrics Prometheus text"

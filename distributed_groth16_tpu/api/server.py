@@ -17,7 +17,11 @@ routes above, funnels through one queue + bounded worker pool):
 
   POST   /jobs/prove      same multipart fields + optional `mpc` flag;
                           returns {jobId, state} immediately
-  GET    /jobs/{id}       status DTO (state, timestamps, phases, error)
+  GET    /jobs/{id}       status DTO (state, timestamps, phases, error,
+                          span tree + critical path under `metrics`)
+  GET    /jobs/{id}/trace Chrome trace-event JSON of the job's merged
+                          per-party timeline (open in chrome://tracing /
+                          Perfetto; `dg16-cli trace` is the CLI spelling)
   GET    /jobs/{id}/result  proof DTO once DONE (409 while in flight)
   DELETE /jobs/{id}       cancel (QUEUED never runs; RUNNING cancels
                           cooperatively at the next phase boundary)
@@ -265,6 +269,18 @@ class ApiServer:
             return job
         return web.json_response(job.to_dict())
 
+    async def job_trace(self, request):
+        """Chrome trace-event JSON of the job's span timeline — the
+        compacted terminal snapshot, or the live buffer while running."""
+        job = self._job_or_404(request)
+        if isinstance(job, web.Response):
+            return job
+        return web.Response(
+            text=job.chrome_trace_json(),
+            content_type="application/json",
+            charset="utf-8",
+        )
+
     async def job_result(self, request):
         job = self._job_or_404(request)
         if isinstance(job, web.Response):
@@ -351,6 +367,7 @@ class ApiServer:
         )
         app.router.add_post("/jobs/prove", self.jobs_prove)
         app.router.add_get("/jobs/{job_id}", self.job_status)
+        app.router.add_get("/jobs/{job_id}/trace", self.job_trace)
         app.router.add_get("/jobs/{job_id}/result", self.job_result)
         app.router.add_delete("/jobs/{job_id}", self.job_cancel)
         app.router.add_get("/healthz", self.healthz)

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -24,6 +23,7 @@ from ...parallel.mesh import (
     _mesh_dmsm_batched,
     _own_row,
     make_mesh,  # noqa: F401  (re-exported convenience)
+    mesh_jit,
     shard_map,
 )
 from ...parallel.pss import PackedSharingParams
@@ -127,7 +127,9 @@ def build_mesh_prover(pp: PackedSharingParams, m: int, mesh: Mesh,
         in_specs=(sharded,) * n_in,
         out_specs=(sharded,) * n_out,
     )
-    return jax.jit(mapped)
+    # compile cost is THE first-run number at m=32768 — record it
+    # (compile_seconds{fn}, compile_cache_{hits,misses}_total)
+    return mesh_jit("mesh_prover_zk" if zk else "mesh_prover", mapped)
 
 
 def mesh_prove(pp, m, mesh, inp: MeshProverInputs):

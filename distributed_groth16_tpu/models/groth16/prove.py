@@ -27,6 +27,7 @@ from ...parallel.dmsm import d_msm
 from ...parallel.net import Net
 from ...parallel.packing import pack_consecutive
 from ...parallel.pss import PackedSharingParams
+from ...telemetry import aggregate as _aggregate
 from ...telemetry import tracing as _tracing
 from .ext_wit import h as ext_wit_h
 from .keys import Proof, ProvingKey
@@ -223,7 +224,16 @@ async def distributed_prove_party(
             r=r,
             s=s,
         )
-        return PartyProofShare(a=pi_a, b=pi_b, c=pi_c)
+        share = PartyProofShare(a=pi_a, b=pi_b, c=pi_c)
+    # round boundary: ship this party's compacted spans to the king
+    # (TELEMETRY frame on ProdNet; no-op in-process, where the round
+    # harness merges — docs/OBSERVABILITY.md). Outside the prove.party
+    # span so the flush itself never pollutes the round's timeline.
+    if _aggregate.enabled():
+        flush = getattr(net, "flush_telemetry", None)
+        if flush is not None:
+            await flush()
+    return share
 
 
 def prove_single(

@@ -52,6 +52,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Awaitable, Callable, Protocol, Sequence
 
+from ..telemetry import aggregate as _agg
+from ..telemetry import flight as _flight
 from ..telemetry import metrics as _tm
 from ..telemetry import tracing as _tracing
 from ..utils.config import NetConfig
@@ -364,6 +366,14 @@ class BaseNet:
         vals = [value] * self.n_parties if self.is_king else None
         return await self.scatter_from_king(vals, sid, timeout=timeout)
 
+    async def flush_telemetry(self) -> None:
+        """Round-boundary telemetry flush (docs/OBSERVABILITY.md). The
+        default is a no-op: in-process backends share one span buffer, so
+        the LocalSimNet round harness merges by pid at the round's end
+        (`aggregate.merge_local`); ProdNet overrides this to ship a
+        TELEMETRY frame across the real transport."""
+        return None
+
 
 class LocalSimNet(BaseNet):
     """In-process n-party network: one shared mailbox fabric, one instance
@@ -425,7 +435,13 @@ def simulate_network_round(
             )
             for i in range(n_parties)
         ]
-        return await asyncio.gather(*tasks)
+        out = await asyncio.gather(*tasks)
+        # the round boundary of the in-process star: every party's spans
+        # are in the shared aggregation buffer — merge them by pid and
+        # close the round (critical-path series) while they're complete
+        if _agg.enabled():
+            _agg.merge_local(finish=True)
+        return out
 
     return asyncio.run(_run())
 
@@ -459,8 +475,21 @@ def run_round_with_retries(
         except (MpcTimeoutError, MpcDisconnectError) as e:
             if attempt == attempts - 1:
                 _ROUND_FAILURES.inc()
+                # retry exhaustion is a fault trigger: leave a post-mortem
+                # with the last rounds' spans and net events
+                _flight.dump(
+                    "round_retry_exhausted",
+                    extra={"attempts": attempts, "error": str(e)},
+                )
                 raise
             _ROUND_RETRIES.inc()
+            _flight.note("round_retry", attempt=attempt, error=str(e))
+            # the failed attempt never reached its round-boundary merge —
+            # drop its spans so the NEXT attempt's critical path doesn't
+            # span both attempts plus the backoff gap (the flight
+            # recorder's ring keeps its own copy for the post-mortem)
+            if _agg.enabled():
+                _agg.drain()
             log.warning(
                 "round attempt %d/%d failed (%s); retrying",
                 attempt + 1, attempts, e,

@@ -26,7 +26,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
-from ..telemetry.tracing import TraceBuffer
+from ..telemetry import aggregate as _aggregate
+from ..telemetry.tracing import TraceBuffer, chrome_envelope
 from ..utils.timers import PhaseTimings
 
 
@@ -83,8 +84,12 @@ class ProofJob:
         # at phase boundaries — the only cross-thread signal a job carries
         self._cancel_flag = threading.Event()
         self._done = asyncio.Event()
-        # terminal-state trace snapshot (see _finish)
+        # terminal-state trace snapshots (see _finish): the span tree for
+        # the status DTO, the raw Chrome trace for GET /jobs/{id}/trace,
+        # and the round critical-path decomposition
         self._spans_json: str | None = None
+        self._chrome_json: str | None = None
+        self._critical_path: dict | None = None
         self._dropped_spans = 0
 
     # -- executor-side hooks (worker thread) --------------------------------
@@ -126,9 +131,44 @@ class ProofJob:
         self.fields = {}
         # likewise the raw trace events: up to 4096 dicts per job across
         # 1024 retained jobs is hundreds of MB of Python objects. Compact
-        # the span tree to one JSON string (tens of KB) and drop them.
+        # the span tree + the Chrome trace to JSON strings (tens of KB)
+        # and drop them. An MPC job's trace holds EVERY party's spans
+        # (the contextvar buffer flows into the per-party tasks), so the
+        # Chrome export is already the merged per-job timeline — one
+        # track per party — and supports a critical-path decomposition.
         self._dropped_spans = self.trace.dropped
+        events = self.trace.events()
         self._spans_json = json.dumps(self.trace.span_tree())
+        self._chrome_json = json.dumps(chrome_envelope(events))
+        if events:
+            # window the decomposition to the MPC round: the harness
+            # spans ("job", the load/witness/packing phases) are pid-0
+            # wrappers covering the whole timeline, which would read as
+            # king ~= wall and wire ~= 0. Inside the "MPC Proof" phase
+            # the only spans are the per-party rounds, so the
+            # king/straggler/wire split is real. A non-MPC job keeps the
+            # whole-trace numbers (single-track: never recorded anyway).
+            window = [e for e in events if e.get("name") == "MPC Proof"]
+            if window:
+                w0 = window[0]["ts"]
+                w1 = w0 + window[0]["dur"]
+                round_evs = [
+                    e for e in events
+                    if e.get("name") != "MPC Proof"
+                    and e.get("ts", 0) >= w0
+                    and e.get("ts", 0) + e.get("dur", 0) <= w1
+                ]
+            else:
+                round_evs = events
+            cp = _aggregate.critical_path(round_evs)
+            self._critical_path = cp
+            # record into the shared round series only when the plane is
+            # OFF — with DG16_AGG on, the round boundary (merge_local's
+            # finish_round) already recorded this round, and recording
+            # here too would double every histogram sample. Single-track
+            # jobs have no straggler and are never recorded.
+            if cp["parties"] > 1 and not _aggregate.enabled():
+                _aggregate.record_critical_path(cp)
         self.trace.clear()
         self._done.set()
 
@@ -137,6 +177,13 @@ class ProofJob:
         wrappers' submit-and-await path)."""
         await self._done.wait()
         return self
+
+    def chrome_trace_json(self) -> str:
+        """The job's Chrome trace-event JSON (GET /jobs/{id}/trace):
+        the compacted snapshot once terminal, the live buffer before."""
+        if self._chrome_json is not None:
+            return self._chrome_json
+        return json.dumps(self.trace.chrome_trace())
 
     @property
     def runtime_s(self) -> float | None:
@@ -159,11 +206,13 @@ class ProofJob:
                 {
                     "spans": json.loads(self._spans_json),
                     "droppedSpans": self._dropped_spans,
+                    "criticalPath": self._critical_path,
                 }
                 if self._spans_json is not None
                 else {
                     "spans": self.trace.span_tree(),
                     "droppedSpans": self.trace.dropped,
+                    "criticalPath": None,
                 }
             ),
         }

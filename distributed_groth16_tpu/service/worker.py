@@ -32,7 +32,7 @@ from ..models.groth16.prove import prove_single
 from ..ops.field import fr
 from ..parallel.net import job_context, run_round_with_retries
 from ..parallel.pss import PackedSharingParams
-from ..telemetry import tracing
+from ..telemetry import aggregate, tracing
 from ..utils.config import ServiceConfig
 from ..utils.timers import phase
 from .crs_cache import CrsCache
@@ -144,6 +144,15 @@ class ProofExecutor:
                 return await distributed_prove_party(
                     pp, d[0], d[1], d[2], d[3], net
                 )
+
+            # round boundary for the aggregation plane: the load/witness/
+            # packing spans above are harness (pid 0) work — drop them so
+            # the round close at simulate_network_round's end decomposes
+            # only the MPC round (million.py does the same; concurrent
+            # jobs on one process buffer still interleave — the per-job
+            # windowed decomposition in jobs.py is the exact one)
+            if aggregate.enabled():
+                aggregate.drain()
 
             with phase("MPC Proof", timings):
                 res = run_round_with_retries(

@@ -1,9 +1,12 @@
-"""Telemetry spine: the process-wide metrics registry (metrics.py) and
-span tracing with Chrome trace-event export (tracing.py). Every layer —
-transport, distributed kernels, prover, service, API, bench — records
-through here; docs/OBSERVABILITY.md is the catalog and naming convention.
+"""Telemetry spine: the process-wide metrics registry (metrics.py), span
+tracing with Chrome trace-event export (tracing.py), the star-wide
+aggregation plane — clock alignment, cross-party trace merging, critical
+path (aggregate.py) — the fault flight recorder (flight.py), and JAX
+compile-cost accounting (compile.py). Every layer — transport,
+distributed kernels, prover, service, API, bench — records through here;
+docs/OBSERVABILITY.md is the catalog and naming convention.
 """
 
-from . import metrics, tracing  # noqa: F401
+from . import aggregate, flight, metrics, tracing  # noqa: F401
 from .metrics import registry  # noqa: F401
 from .tracing import TraceBuffer, collect, span  # noqa: F401

@@ -50,6 +50,25 @@ _IDS = itertools.count(1)
 _global_buffer: "TraceBuffer | None" = None
 _global_path: str | None = None
 
+# Rarely-installed extra recording targets (the aggregation buffer of
+# telemetry/aggregate.py, the flight-recorder ring of telemetry/flight.py).
+# A tuple, rebuilt on (un)install, so the idle fast path stays one truthy
+# check — `span()` must remain allocation-free with nothing installed.
+_extra_sinks: tuple = ()
+
+
+def add_sink(sink) -> None:
+    """Install an extra span sink (anything with `.add(ev)`); spans record
+    into it whenever they record at all. Idempotent."""
+    global _extra_sinks
+    if all(s is not sink for s in _extra_sinks):
+        _extra_sinks = _extra_sinks + (sink,)
+
+
+def remove_sink(sink) -> None:
+    global _extra_sinks
+    _extra_sinks = tuple(s for s in _extra_sinks if s is not sink)
+
 
 class TraceBuffer:
     """Bounded, thread-safe sink of finished span events (dicts in Chrome
@@ -73,6 +92,16 @@ class TraceBuffer:
         with self._lock:
             return list(self._events)
 
+    def take(self) -> list[dict]:
+        """Atomically remove and return everything recorded so far — the
+        drain primitive. A plain events()+clear() pair would destroy any
+        span recorded between the two lock acquisitions."""
+        with self._lock:
+            out = self._events
+            self._events = []
+            self.dropped = 0
+            return out
+
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
@@ -84,7 +113,7 @@ class TraceBuffer:
 
     def chrome_trace(self) -> dict:
         """The chrome://tracing / Perfetto JSON object."""
-        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+        return chrome_envelope(self.events())
 
     def dump(self, path: str) -> None:
         with open(path, "w") as f:
@@ -120,6 +149,11 @@ class TraceBuffer:
             node["children"].sort(key=lambda n: n["startUs"])
         roots.sort(key=lambda n: n["startUs"])
         return roots
+
+
+def chrome_envelope(events: list[dict]) -> dict:
+    """The one Chrome trace-file wrapper every export path shares."""
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 class _NoopSpan:
@@ -212,16 +246,26 @@ def span(
     no-op when no buffer is active and no sink was given."""
     b = _BUFFER.get()
     g = _global_buffer
-    if b is None and g is None:
+    x = _extra_sinks
+    if b is None and g is None and not x:
         if timings is None:
             return NOOP
         bufs = ()
-    elif b is None:
-        bufs = (g,)
-    elif g is None or g is b:
-        bufs = (b,)
+    elif not x:
+        if b is None:
+            bufs = (g,)
+        elif g is None or g is b:
+            bufs = (b,)
+        else:
+            bufs = (b, g)
     else:
-        bufs = (b, g)
+        # slow path: something unusual (agg buffer / flight ring) is
+        # installed; dedup by identity — recording allocates anyway
+        seen: list = []
+        for s in (b, g) + x:
+            if s is not None and all(s is not t for t in seen):
+                seen.append(s)
+        bufs = tuple(seen)
     a = attrs
     if sid is not None or job is not None:
         a = dict(attrs) if attrs else {}
@@ -234,7 +278,11 @@ def span(
 
 def active() -> bool:
     """True when at least one buffer would record spans."""
-    return _BUFFER.get() is not None or _global_buffer is not None
+    return (
+        _BUFFER.get() is not None
+        or _global_buffer is not None
+        or bool(_extra_sinks)
+    )
 
 
 @contextmanager

@@ -33,12 +33,22 @@ def main() -> int:
                         "proof (open in chrome://tracing or Perfetto); "
                         "DG16_TRACE_OUT is the env equivalent "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--agg-out", default=None,
+                   help="enable the star-wide aggregation plane (DG16_AGG) "
+                        "and write the merged per-party trace here, with a "
+                        "critical-path summary — king vs straggler vs wire "
+                        "(docs/OBSERVABILITY.md)")
     args = p.parse_args()
 
     if args.trace_out:
         from distributed_groth16_tpu.telemetry import tracing
 
         tracing.enable_global(args.trace_out)
+    if args.agg_out:
+        from distributed_groth16_tpu.telemetry import aggregate
+
+        aggregate.set_enabled(True)
+        aggregate.reset_aggregator()
 
     from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
     from distributed_groth16_tpu.models.groth16 import (
@@ -112,6 +122,14 @@ def main() -> int:
 
         net_cfg = _dc_replace(net_cfg, op_timeout_s=0.0)
 
+    if args.agg_out:
+        # the dealer phases above (setup, packing) are king-process spans
+        # too; drop them here so the merged trace and the critical-path
+        # decomposition cover exactly the MPC round
+        from distributed_groth16_tpu.telemetry import aggregate
+
+        aggregate.drain()
+
     with phase("MPC Proof", timings):
         # a transient transport fault (timeout, dead link) re-runs the
         # round on a fresh fabric instead of killing a multi-hour proof
@@ -131,6 +149,21 @@ def main() -> int:
     proof = reassemble_proof(res[0], pk)
     ok = verify(pk.vk, proof, z[1 : r1cs.num_instance])
     print(f"MPC proof verifies: {ok}")
+
+    if args.agg_out:
+        from distributed_groth16_tpu.telemetry import aggregate
+
+        agg = aggregate.aggregator()
+        agg.dump(args.agg_out)
+        print(f"merged star trace ({len(agg.parties())} tracks) "
+              f"written to {args.agg_out}")
+        cp = agg.last_critical_path
+        if cp:
+            print("round critical path (s): "
+                  f"king {cp['king']:.3f}  "
+                  f"straggler {cp['straggler']:.3f} "
+                  f"(party {cp['stragglerParty']})  "
+                  f"wire {cp['wire']:.3f}  wall {cp['wall']:.3f}")
 
     print("phase timings (ms):")
     for k, v in timings.as_millis().items():

@@ -1,0 +1,666 @@
+"""Star-wide telemetry plane tests (telemetry/aggregate.py +
+telemetry/flight.py + the TELEMETRY frame / clock echo of prodnet.py;
+docs/OBSERVABILITY.md "Distributed tracing & flight recorder").
+
+Covers: the NTP-style echo math and min-rtt window, clock-offset
+convergence against a genuinely skewed peer clock under FaultyIO delay
+jitter, per-party track merging with clock rebasing, the critical-path
+decomposition (pure and over a real multi-party LocalTestNet proof),
+TELEMETRY frames shipping client spans + metric snapshots to the king,
+the DG16_AGG-off idle guard (no frames, no drain), the flight recorder's
+post-mortem dump on an injected peer death, and the GET /jobs/{id}/trace
++ `dg16-cli trace` surface.
+
+The aggregation plane is process-global (like the metrics registry), so
+every test enables it explicitly and the autouse fixture restores the
+idle default — the hot-path allocation guard in test_telemetry.py relies
+on it.
+"""
+
+import asyncio
+import glob
+import json
+import os
+
+import pytest
+
+from distributed_groth16_tpu.parallel.faults import FaultyIO
+from distributed_groth16_tpu.parallel.net import simulate_network_round
+from distributed_groth16_tpu.parallel.prodnet import ChannelIO, ProdNet
+from distributed_groth16_tpu.telemetry import aggregate, flight
+from distributed_groth16_tpu.telemetry import metrics as tm
+from distributed_groth16_tpu.telemetry import tracing
+from distributed_groth16_tpu.utils.config import NetConfig
+
+REG = tm.registry()
+
+FAST = NetConfig(
+    op_timeout_s=5.0,
+    connect_timeout_s=5.0,
+    heartbeat_interval_s=0.0,
+)
+
+
+@pytest.fixture(autouse=True)
+def _plane_off():
+    """Every test starts and ends with the aggregation plane + flight
+    recorder + global trace buffer off (the idle default the rest of the
+    suite, notably the hot-path allocation guard, depends on)."""
+    tracing.disable_global()
+    aggregate.set_enabled(False)
+    flight.disable()
+    yield
+    tracing.disable_global()
+    aggregate.set_enabled(False)
+    flight.disable()
+    aggregate.reset_aggregator()
+
+
+def _counter(name: str, **labels) -> float:
+    fam = REG.counter(name, labelnames=tuple(labels))
+    return (fam.labels(**labels) if labels else fam).value
+
+
+def _bounded(coro, s: float = 30.0):
+    return asyncio.run(asyncio.wait_for(coro, s))
+
+
+# -- clock sync --------------------------------------------------------------
+
+
+def test_clock_echo_math_recovers_offset_and_rtt():
+    # peer clock 5s ahead; 100ns each way on the wire, 100ns hold at peer
+    off, rtt = aggregate.ClockSync.from_echo(
+        0, 5_000_000_100, 5_000_000_200, 300
+    )
+    assert off == 5_000_000_000
+    assert rtt == 200
+
+
+def test_clock_sync_min_rtt_wins_and_window_slides():
+    cs = aggregate.ClockSync(window=4)
+    assert cs.offset_ns == 0  # unsampled default
+    cs.add_sample(offset_ns=100, rtt_ns=50)
+    cs.add_sample(offset_ns=999, rtt_ns=500)  # high-rtt: worse bound
+    assert cs.offset_ns == 100
+    cs.add_sample(offset_ns=-7, rtt_ns=-1)  # corrupt echo discarded
+    assert cs.n_samples == 2
+    # a skew introduced mid-run ages the stale low-rtt sample out
+    for _ in range(4):
+        cs.add_sample(offset_ns=5_000, rtt_ns=80)
+    assert cs.offset_ns == 5_000
+
+
+def test_clock_offset_converges_on_skewed_peer_clock():
+    """The acceptance estimator test: the client's telemetry clock runs
+    3s ahead and its IO carries seeded delay jitter (FaultyIO); the
+    king's heartbeat-echo estimate must converge to the skew within the
+    jitter bound (error <= rtt/2 <= max_delay_s)."""
+    SKEW_NS = 3_000_000_000
+
+    class SkewedNet(ProdNet):
+        def _now_ns(self):
+            return aggregate.now_ns() + SKEW_NS
+
+    cfg = NetConfig(
+        op_timeout_s=5.0, connect_timeout_s=5.0,
+        heartbeat_interval_s=0.05, idle_timeout_s=10.0,
+    )
+
+    async def run():
+        a, b = ChannelIO.pair()
+        faulty = FaultyIO(b, seed=7, delay_p=0.5, max_delay_s=0.02)
+        king_t = asyncio.create_task(ProdNet.king_from_ios({1: a}, 2, cfg))
+        peer_t = asyncio.create_task(
+            SkewedNet.peer_from_io(1, faulty, 2, cfg)
+        )
+        king, peer = await king_t, await peer_t
+        try:
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if king._clocks[1].n_samples >= 4:
+                    break
+            est = king._clocks[1].offset_ns
+            assert king._clocks[1].n_samples >= 4
+            assert abs(est - SKEW_NS) < 100_000_000, est  # within 0.1s
+            # the symmetric estimate on the client side sees -SKEW
+            assert abs(peer._clocks[0].offset_ns + SKEW_NS) < 100_000_000
+            # gauges surfaced per peer
+            assert REG.gauge(
+                "clock_offset_seconds", labelnames=("peer",)
+            ).labels(peer="1").value == pytest.approx(est / 1e9)
+        finally:
+            await king.close()
+            await peer.close()
+
+    _bounded(run())
+
+
+# -- aggregator / critical path ----------------------------------------------
+
+
+def _ev(name, ts, dur, pid, id, parent=0):
+    return {
+        "name": name, "ph": "X", "ts": float(ts), "dur": float(dur),
+        "pid": pid, "tid": 1, "args": {"id": id, "parent": parent},
+    }
+
+
+def test_aggregator_rebases_and_tracks_per_party():
+    agg = aggregate.TraceAggregator()
+    agg.add_party(0, [_ev("king.work", 100, 50, 0, 1)])
+    # client events timestamped 2s ahead: rebase with -2s
+    agg.add_party(
+        1,
+        [_ev("client.work", 2_000_100, 40, 7, 2)],
+        offset_ns=-2_000_000_000,
+        metrics={"net_bytes_sent_total": 123.0},
+    )
+    assert agg.parties() == [0, 1]
+    trace = agg.chrome_trace()
+    meta = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+    assert [(m["pid"], m["args"]["name"]) for m in meta] == [
+        (0, "king (party 0)"), (1, "party 1"),
+    ]
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["client.work"]["ts"] == pytest.approx(100.0)  # rebased
+    assert by_name["client.work"]["pid"] == 1  # pid forced to party
+    assert agg.party_metrics()[1] == {"net_bytes_sent_total": 123.0}
+
+
+def test_critical_path_decomposition_synthetic():
+    # king: 100µs round, 30µs of it inside a gather -> 70µs compute
+    # client 1: 60µs with a 20µs collective -> 40µs busy (the straggler)
+    # client 2: 10µs busy
+    events = [
+        _ev("prove.party", 0, 100, 0, 1),
+        _ev("net.gather_to_king", 10, 30, 0, 2, parent=1),
+        _ev("prove.party", 0, 60, 1, 3),
+        _ev("net.gather_to_king", 40, 20, 1, 4, parent=3),
+        _ev("prove.party", 0, 10, 2, 5),
+    ]
+    cp = aggregate.critical_path(events)
+    assert cp["parties"] == 3
+    assert cp["king"] == pytest.approx(70e-6)
+    assert cp["straggler"] == pytest.approx(40e-6)
+    assert cp["stragglerParty"] == 1
+    assert cp["wall"] == pytest.approx(100e-6)
+    # wire = wall - union of busy: king busy [0,10)+[40,100), c1 [0,40),
+    # c2 [0,10) -> union [0,100) -> 0 here
+    assert cp["wire"] == pytest.approx(0.0)
+    assert aggregate.critical_path([])["parties"] == 0
+
+
+def test_finish_round_records_series_and_advances_marks():
+    k_before = {
+        c: REG.histogram(
+            "round_critical_path_seconds", labelnames=("component",)
+        ).labels(component=c).count
+        for c in ("king", "straggler", "wire")
+    }
+    agg = aggregate.TraceAggregator()
+    agg.add_party(0, [_ev("k", 0, 100, 0, 1)])
+    agg.add_party(1, [_ev("c", 0, 50, 1, 2)])
+    cp = agg.finish_round()
+    assert cp["parties"] == 2 and cp["stragglerParty"] == 1
+    fam = REG.histogram(
+        "round_critical_path_seconds", labelnames=("component",)
+    )
+    for c in ("king", "straggler", "wire"):
+        assert fam.labels(component=c).count == k_before[c] + 1
+    # a second finish with no new events is an empty round: no samples
+    cp2 = agg.finish_round()
+    assert cp2["parties"] == 0
+    assert fam.labels(component="king").count == k_before["king"] + 1
+
+
+def test_local_4party_round_merges_one_track_per_party():
+    """A 4-party LocalTestNet round with the plane on: the harness merges
+    by pid at the round boundary, timestamps stay monotone (offset 0),
+    and the critical-path series gain samples."""
+    aggregate.set_enabled(True)
+    agg = aggregate.reset_aggregator()
+
+    async def party(net, _):
+        with tracing.span("party.work", party=net.party_id):
+            await asyncio.sleep(0.01 * (net.party_id + 1))
+            return await net.king_compute(
+                net.party_id, lambda ids: [sum(ids)] * net.n_parties
+            )
+
+    out = simulate_network_round(4, party, net_cfg=FAST)
+    assert out == [6] * 4
+    assert agg.parties() == [0, 1, 2, 3]
+    cp = agg.last_critical_path
+    assert cp is not None and cp["parties"] == 4
+    assert cp["wall"] > 0 and cp["straggler"] > 0
+    # party 3 slept longest inside its compute span
+    assert cp["stragglerParty"] == 3
+    trace = agg.chrome_trace()
+    meta = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+    assert [m["pid"] for m in meta] == [0, 1, 2, 3]
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in evs)
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)  # merged output is time-ordered
+
+
+@pytest.mark.slow
+def test_full_mpc_proof_produces_merged_trace_with_critical_path():
+    """The LocalTestNet acceptance path: a real multi-party proof with
+    DG16_AGG on yields one merged Chrome trace with a track per party
+    and a non-empty round_critical_path_seconds breakdown."""
+    from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
+    from distributed_groth16_tpu.models.groth16 import (
+        CompiledR1CS,
+        distributed_prove_party,
+        pack_from_witness,
+        pack_proving_key,
+        reassemble_proof,
+        setup,
+        verify,
+    )
+    from distributed_groth16_tpu.ops.field import fr
+    from distributed_groth16_tpu.parallel.pss import PackedSharingParams
+
+    aggregate.set_enabled(True)
+    agg = aggregate.reset_aggregator()
+
+    cs = mult_chain_circuit(9, 7)
+    r1cs, z = cs.finish()
+    pk = setup(r1cs)
+    pp = PackedSharingParams(2)
+    z_mont = fr().encode(z)
+    comp = CompiledR1CS(r1cs)
+    qap_shares = comp.qap(z_mont).pss(pp)
+    crs_shares = pack_proving_key(pk, pp, strip=True)
+    a_sh = pack_from_witness(pp, z_mont[1:])
+    ax_sh = pack_from_witness(pp, z_mont[r1cs.num_instance:])
+
+    async def party(net, d):
+        return await distributed_prove_party(pp, d[0], d[1], d[2], d[3], net)
+
+    res = simulate_network_round(
+        pp.n, party,
+        [
+            (crs_shares[i], qap_shares[i], a_sh[i], ax_sh[i])
+            for i in range(pp.n)
+        ],
+    )
+    proof = reassemble_proof(res[0], pk)
+    assert verify(pk.vk, proof, z[1:r1cs.num_instance])
+
+    assert agg.parties() == list(range(pp.n))
+    cp = agg.last_critical_path
+    assert cp["parties"] == pp.n
+    assert cp["wall"] > 0
+    assert cp["king"] > 0  # the A/B/C + dmsm spans are king-side busy too
+    names = {e["name"] for e in agg.events()}
+    assert {"prove.party", "net.gather_to_king"} <= names
+
+
+# -- TELEMETRY frames over the prod transport --------------------------------
+
+
+def test_telemetry_frame_ships_client_spans_to_king():
+    aggregate.set_enabled(True)
+    agg = aggregate.reset_aggregator()
+    tx_before = _counter("telemetry_frames_sent_total", peer="0")
+    rx_before = _counter("telemetry_frames_recv_total", peer="1")
+
+    async def run():
+        a, b = ChannelIO.pair()
+        king_t = asyncio.create_task(ProdNet.king_from_ios({1: a}, 2, FAST))
+        peer_t = asyncio.create_task(ProdNet.peer_from_io(1, b, 2, FAST))
+        king, peer = await king_t, await peer_t
+        try:
+            with tracing.span("client.compute", party=1):
+                await asyncio.sleep(0.01)
+            await peer.flush_telemetry()
+            for _ in range(50):
+                await asyncio.sleep(0.02)
+                if 1 in agg.parties():
+                    break
+            assert 1 in agg.parties()
+            names = {e["name"] for e in agg.events() if e["pid"] == 1}
+            assert "client.compute" in names
+            # the frame carried a metric-registry snapshot alongside
+            assert agg.party_metrics()[1]
+        finally:
+            await king.close()
+            await peer.close()
+
+    _bounded(run())
+    # one round-boundary frame plus the shutdown flush from close()
+    assert _counter("telemetry_frames_sent_total", peer="0") == tx_before + 2
+    assert _counter("telemetry_frames_recv_total", peer="1") >= rx_before + 1
+    # the king closed the round once every live party had contributed
+    assert agg.last_critical_path is not None
+
+
+def test_aggregator_tracks_are_bounded():
+    agg = aggregate.TraceAggregator()
+    cap = agg.MAX_EVENTS_PER_PARTY
+    agg.add_party(1, [_ev("x", i, 1, 1, i + 1) for i in range(cap + 10)])
+    with agg._lock:
+        assert len(agg._tracks[1]) == cap
+    assert agg.dropped == 10
+    # the round mark shifted with the truncation: finish covers the cap
+    assert agg.finish_round()["parties"] == 1
+
+
+def test_agg_off_sends_no_frames_and_drains_nothing():
+    """The idle guard: with DG16_AGG off, flush_telemetry is a no-op on
+    both sides — no TELEMETRY frame, no buffer, spans stay no-ops."""
+    assert not aggregate.enabled()
+    assert aggregate.drain() == []
+    assert not tracing.active()
+    tx_before = _counter("telemetry_frames_sent_total", peer="0")
+
+    async def run():
+        a, b = ChannelIO.pair()
+        king_t = asyncio.create_task(ProdNet.king_from_ios({1: a}, 2, FAST))
+        peer_t = asyncio.create_task(ProdNet.peer_from_io(1, b, 2, FAST))
+        king, peer = await king_t, await peer_t
+        with tracing.span("client.compute", party=1):
+            pass  # no-op singleton: nothing buffered anywhere
+        await peer.flush_telemetry()
+        await king.flush_telemetry()
+        await king.close()
+        await peer.close()
+
+    _bounded(run())
+    assert _counter("telemetry_frames_sent_total", peer="0") == tx_before
+
+
+def test_telemetry_frame_held_until_clock_sample_then_rebased():
+    """Before any heartbeat echo completes, a peer's span timestamps are
+    on an unrelated perf_counter epoch — the frame must be held, then
+    merged with the estimated offset applied once a sample exists."""
+    import json as _json
+
+    from distributed_groth16_tpu.utils import serde
+
+    aggregate.set_enabled(True)
+    agg = aggregate.reset_aggregator()
+    cfg = NetConfig(
+        op_timeout_s=5.0, connect_timeout_s=5.0,
+        heartbeat_interval_s=30.0,  # on (gates the hold), but never fires
+    )
+
+    async def run():
+        a, b = ChannelIO.pair()
+        king_t = asyncio.create_task(ProdNet.king_from_ios({1: a}, 2, cfg))
+        peer_t = asyncio.create_task(ProdNet.peer_from_io(1, b, 2, cfg))
+        king, peer = await king_t, await peer_t
+        try:
+            payload = serde.dumps(_json.dumps({
+                "party": 1,
+                "spans": [_ev("client.work", 5_000_100, 40, 1, 9)],
+                "metrics": {},
+            }))
+            king._on_telemetry(1, payload)
+            assert 1 not in agg.parties()  # held: no clock sample yet
+            assert len(king._pending_tlm[1]) == 1
+            # a completed echo (peer clock 5s ahead) releases the frame:
+            # our earlier send t0, their rx t0+5s+100ns, their send
+            # t0+5s+200ns, our rx = now (sub-ms after t0)
+            t0 = aggregate.now_ns()
+            king._on_heartbeat(1, serde.dumps(
+                (t0 + 5_000_000_200, t0, t0 + 5_000_000_100)
+            ))
+            assert king._clocks[1].n_samples == 1
+            assert 1 in agg.parties()
+            assert king._pending_tlm == {}
+            ev = agg.events()[0]
+            # rebased by -offset: 5_000_100us - ~5s = ~100us (the slack
+            # covers the real microseconds between t0 and the handler's
+            # own clock read)
+            assert ev["ts"] == pytest.approx(100, abs=500)
+        finally:
+            await king.close()
+            await peer.close()
+
+    _bounded(run())
+
+
+def test_retry_drops_failed_attempt_spans():
+    """A retried round's critical path must cover only the attempt that
+    succeeded — the failed attempt's spans (and the backoff gap) would
+    otherwise read as a fabricated wire bottleneck."""
+    from distributed_groth16_tpu.parallel.net import (
+        MpcTimeoutError,
+        run_round_with_retries,
+    )
+
+    aggregate.set_enabled(True)
+    agg = aggregate.reset_aggregator()
+    state = {"attempt": 0}
+
+    async def party(net, _):
+        if net.party_id == 0:
+            state["attempt"] += 1
+        with tracing.span(f"attempt{state['attempt']}.p{net.party_id}",
+                          party=net.party_id):
+            await asyncio.sleep(0)
+        if state["attempt"] == 1 and net.party_id == 1:
+            raise MpcTimeoutError("transient", party=1)
+        return net.party_id
+
+    out = run_round_with_retries(2, party, retries=2, net_cfg=FAST)
+    assert out == [0, 1]
+    names = {e["name"] for e in agg.events()}
+    assert "attempt2.p0" in names
+    assert not any(n.startswith("attempt1") for n in names)
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_recorder_rings_are_bounded():
+    rec = flight.FlightRecorder("/tmp/unused", max_spans=4, max_net_events=2)
+    for i in range(10):
+        rec.add({"name": f"s{i}"})
+        rec.note("evt", i=i)
+    assert len(rec._spans) == 4
+    assert [e["i"] for e in rec._net] == [8, 9]
+
+
+def test_flight_dump_rate_limited_per_trigger(tmp_path):
+    """A fault storm must cost a bounded number of post-mortems."""
+    rec = flight.FlightRecorder(str(tmp_path), max_dumps_per_trigger=3)
+    paths = [rec.dump("peer_death", party=0) for _ in range(6)]
+    assert sum(p is not None for p in paths) == 3
+    assert paths[3:] == [None, None, None]
+    assert rec.dump("round_retry_exhausted") is not None  # per-trigger cap
+    assert len(glob.glob(os.path.join(str(tmp_path), "flight-*.json"))) == 4
+
+
+def test_add_party_drops_malformed_events():
+    """A version-skewed or hostile peer's TELEMETRY frame must not be
+    able to crash the king-side round close (critical_path arithmetic)."""
+    agg = aggregate.TraceAggregator()
+    agg.add_party(1, [
+        "not a dict",
+        {"name": "no-ts-dur"},
+        {"name": "bad-types", "ts": "x", "dur": None},
+        _ev("ok", 5, 10, 1, 1),
+    ])
+    assert [e["name"] for e in agg.events()] == ["ok"]
+    cp = agg.finish_round()  # arithmetic survives the sanitized track
+    assert cp["parties"] == 1
+
+
+def test_drain_is_atomic_take():
+    aggregate.set_enabled(True)
+    with tracing.span("t.a"):
+        pass
+    evs = aggregate.drain()
+    assert [e["name"] for e in evs] == ["t.a"]
+    assert aggregate.drain() == []
+
+
+def test_flight_dump_on_injected_peer_death(tmp_path):
+    """The acceptance post-mortem: an injected mid-collective peer death
+    leaves a dump naming the dead peer, with the recent net events and a
+    metric snapshot inside."""
+    flight.configure(str(tmp_path))
+    dumps_before = _counter("flight_dumps_total", trigger="peer_death")
+    wrap = {1: lambda io: FaultyIO(io, disconnect_write_at=1)}
+
+    async def run():
+        pairs = {1: ChannelIO.pair()}
+        client_io = wrap[1](pairs[1][1])
+        king_t = asyncio.create_task(
+            ProdNet.king_from_ios({1: pairs[1][0]}, 2, FAST)
+        )
+        peer_t = asyncio.create_task(
+            ProdNet.peer_from_io(1, client_io, 2, FAST)
+        )
+        king, peer = await king_t, await peer_t
+        from distributed_groth16_tpu.parallel.net import MpcDisconnectError
+
+        with pytest.raises(MpcDisconnectError):
+            await peer.send_to(0, 42)  # write #1 disconnects
+        with pytest.raises(MpcDisconnectError):
+            await king.recv_from(1, timeout=5.0)
+        await king.close()
+        await peer.close()
+
+    _bounded(run())
+    assert _counter("flight_dumps_total", trigger="peer_death") > dumps_before
+    files = sorted(glob.glob(os.path.join(str(tmp_path), "flight-*.json")))
+    assert files, "no flight dump written"
+    # at least one dump names the dead peer 1 from the king's side
+    records = [json.load(open(f)) for f in files]
+    king_side = [
+        r for r in records
+        if r["trigger"] == "peer_death" and r["extra"].get("peer") == 1
+    ]
+    assert king_side, records
+    rec = king_side[0]
+    assert any(e["kind"] == "peer_death" for e in rec["netEvents"])
+    assert rec["metrics"], "metric snapshot missing from post-mortem"
+    assert rec["extra"]["reason"]
+
+
+def test_flight_dump_on_round_retry_exhaustion(tmp_path):
+    from distributed_groth16_tpu.parallel.net import (
+        MpcDisconnectError,
+        run_round_with_retries,
+    )
+
+    flight.configure(str(tmp_path))
+
+    async def party(net, _):
+        raise MpcDisconnectError("permanently dead", party=net.party_id)
+
+    with pytest.raises(MpcDisconnectError):
+        run_round_with_retries(2, party, retries=1, net_cfg=FAST)
+    files = glob.glob(
+        os.path.join(str(tmp_path), "flight-*round_retry_exhausted.json")
+    )
+    assert files
+    rec = json.load(open(files[0]))
+    assert rec["extra"]["attempts"] == 2
+    # the retry that preceded exhaustion is in the ring
+    assert any(e["kind"] == "round_retry" for e in rec["netEvents"])
+
+
+# -- service + CLI surface ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def circuit(tmp_path_factory):
+    from distributed_groth16_tpu.api.store import CircuitStore
+    from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
+    from distributed_groth16_tpu.frontend.readers import write_r1cs, write_wtns
+
+    cs = mult_chain_circuit(9, 7)
+    r1cs, z = cs.finish()
+    root = str(tmp_path_factory.mktemp("agg_store"))
+    cid = CircuitStore(root).save_circuit("agg", write_r1cs(r1cs), b"")
+    return root, cid, write_wtns(z)
+
+
+def test_job_trace_endpoint_serves_chrome_json(circuit):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from distributed_groth16_tpu.api.server import ApiServer
+    from distributed_groth16_tpu.api.store import CircuitStore
+    from distributed_groth16_tpu.utils.config import ServiceConfig
+
+    root, cid, wtns = circuit
+
+    async def run():
+        server = ApiServer(
+            CircuitStore(root), ServiceConfig(workers=1, queue_bound=8)
+        )
+        client = TestClient(TestServer(server.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/jobs/prove", data={"circuit_id": cid, "witness_file": wtns}
+            )
+            body = await resp.json()
+            assert resp.status == 202, body
+            jid = body["jobId"]
+            while True:
+                resp = await client.get(f"/jobs/{jid}")
+                st = await resp.json()
+                if st["state"] in ("DONE", "FAILED", "CANCELLED"):
+                    break
+                await asyncio.sleep(0.05)
+            assert st["state"] == "DONE", st
+            resp = await client.get(f"/jobs/{jid}/trace")
+            assert resp.status == 200
+            assert resp.content_type == "application/json"
+            trace = json.loads(await resp.text())
+            resp = await client.get("/jobs/nope/trace")
+            assert resp.status == 404
+            return st, trace
+        finally:
+            await client.close()
+
+    st, trace = asyncio.run(run())
+    evs = trace["traceEvents"]
+    assert evs and all(e["ph"] == "X" for e in evs)
+    assert "job" in {e["name"] for e in evs}
+    # the status DTO carries the job's critical-path decomposition
+    cp = st["metrics"]["criticalPath"]
+    assert cp is not None and cp["wall"] > 0 and cp["parties"] >= 1
+
+
+def test_cli_trace_subcommand_writes_file(tmp_path, monkeypatch):
+    from distributed_groth16_tpu.api import cli
+
+    payload = json.dumps(
+        {"traceEvents": [{"name": "job", "ph": "X", "ts": 0, "dur": 1,
+                          "pid": 0, "tid": 0, "args": {}}],
+         "displayTimeUnit": "ms"}
+    )
+
+    class FakeResp:
+        status_code = 200
+        text = payload
+
+        def json(self):
+            return json.loads(payload)
+
+    seen = {}
+
+    def fake_get(url, timeout):
+        seen["url"] = url
+        return FakeResp()
+
+    monkeypatch.setattr(cli.requests, "get", fake_get)
+    out = str(tmp_path / "t.json")
+    import argparse
+
+    res = cli.cmd_trace(
+        argparse.Namespace(url="http://x", job_id="abc123", out=out)
+    )
+    assert seen["url"] == "http://x/jobs/abc123/trace"
+    assert res == {"jobId": "abc123", "out": out, "events": 1}
+    assert json.loads(open(out).read())["traceEvents"]

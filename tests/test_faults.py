@@ -439,6 +439,115 @@ def test_kill_client_mid_gather_over_real_sockets():
     _bounded(run())
 
 
+def test_kill_mid_gather_leaves_flight_dump_and_merged_trace(tmp_path):
+    """Telemetry-plane acceptance over real TCP: a mid-gather peer kill
+    (a) leaves a flight-recorder post-mortem naming the dead peer with
+    the last net events, and (b) the surviving clients' TELEMETRY frames
+    still merge into a king-side trace with a critical-path breakdown
+    (docs/OBSERVABILITY.md "Distributed tracing & flight recorder")."""
+    import os
+
+    from distributed_groth16_tpu.telemetry import aggregate, flight, tracing
+
+    N = 4
+    # CI points DG16_FLIGHT_ARTIFACT_DIR at a workspace path so the dumps
+    # and the merged trace upload as a workflow artifact on failure
+    art_dir = os.environ.get("DG16_FLIGHT_ARTIFACT_DIR") or str(tmp_path)
+    flight.configure(art_dir)
+    aggregate.set_enabled(True)
+    agg = aggregate.reset_aggregator()
+
+    async def run():
+        port = _free_port()
+        king_task = asyncio.create_task(
+            ProdNet.new_king(("127.0.0.1", port), N, net_cfg=FAST)
+        )
+        peers = await asyncio.gather(
+            *(
+                ProdNet.new_peer(i, ("127.0.0.1", port), N, net_cfg=FAST)
+                for i in range(1, N)
+            )
+        )
+        king = await king_task
+
+        async def client(net):
+            if net.party_id == 1:
+                await net.close()  # crash mid-collective
+                return
+            with tracing.span("client.compute", party=net.party_id):
+                await asyncio.sleep(0.01)
+            try:
+                await net.send_to(0, net.party_id * 10)
+            except MpcNetError:
+                pass  # the star failed fast via the king's ERR relay
+            # post-fault flush: the socket to the king is still healthy
+            # even though the relay marked the star dead — the frames are
+            # the post-mortem's raw material
+            await net.flush_telemetry()
+
+        async def king_side():
+            with pytest.raises(MpcNetError) as ei:
+                await king.gather_to_king(0, timeout=5.0)
+            assert ei.value.peer == 1
+
+        await asyncio.gather(king_side(), *(client(p) for p in peers))
+        await king.flush_telemetry()
+        # client frames arrive on the pump; wait for both survivors
+        for _ in range(100):
+            if {2, 3} <= set(agg.parties()):
+                break
+            await asyncio.sleep(0.02)
+        await king.close()
+        for p in peers:
+            await p.close()
+
+    try:
+        _bounded(run())
+        assert {2, 3} <= set(agg.parties())
+        cp = agg.finish_round()
+        if cp["parties"] == 0:
+            # the king auto-closed the round when the last live party's
+            # frame arrived — the decomposition is already recorded
+            cp = agg.last_critical_path
+        # NB: in this single-process harness all parties share one span
+        # buffer, so the first survivor's flush ships the bulk of the
+        # events under its own track — per-party attribution is exact
+        # only with one process per party (the production shape; the
+        # LocalTestNet tests in test_agg_trace.py cover multi-track
+        # attribution). The breakdown must still be non-empty.
+        assert cp["parties"] >= 1 and cp["wall"] > 0
+        meta_pids = [
+            e["pid"]
+            for e in agg.chrome_trace()["traceEvents"]
+            if e.get("ph") == "M"
+        ]
+        assert {2, 3} <= set(meta_pids)
+        # the merged trace lands next to the dumps (CI artifact on failure)
+        agg.dump(os.path.join(art_dir, "merged-trace.json"))
+        # the post-mortem names the dead peer and keeps the lead-up
+        import glob
+        import json
+
+        records = [
+            json.load(open(f))
+            for f in glob.glob(os.path.join(art_dir, "flight-*.json"))
+        ]
+        king_side_dumps = [
+            r for r in records
+            if r["trigger"] == "peer_death" and r["extra"].get("peer") == 1
+        ]
+        assert king_side_dumps, records
+        assert any(
+            e["kind"] == "peer_death"
+            for e in king_side_dumps[0]["netEvents"]
+        )
+        assert king_side_dumps[0]["metrics"]
+    finally:
+        flight.disable()
+        aggregate.set_enabled(False)
+        aggregate.reset_aggregator()
+
+
 def test_client_dials_before_king_listens():
     """Backoff-retry regression (acceptance): a client whose first dial
     lands before the king is listening connects once the king comes up."""
