@@ -27,7 +27,9 @@ routes above, funnels through one queue + bounded worker pool):
                           cooperatively at the next phase boundary)
   GET    /healthz         liveness + pool shape
   GET    /stats           queue depth/counters, CRS-cache hit rate,
-                          per-phase timing aggregates
+                          per-phase timing aggregates, batching-scheduler
+                          bucket/placement state when DG16_BATCH_MAX > 1
+                          (docs/SCHEDULER.md)
   GET    /metrics         Prometheus text exposition of the process-wide
                           telemetry registry (docs/OBSERVABILITY.md)
 
@@ -59,7 +61,7 @@ from ..service import (
     QueueFullError,
     WorkerPool,
 )
-from ..utils.config import ServiceConfig
+from ..utils.config import SchedulerConfig, ServiceConfig
 from .store import CircuitStore
 
 MAX_BODY = 100 * 1024 * 1024  # 100 MB limit (main.rs:801)
@@ -101,9 +103,11 @@ class ApiServer:
         self,
         store: CircuitStore | None = None,
         cfg: ServiceConfig | None = None,
+        sched_cfg: SchedulerConfig | None = None,
     ):
         self.store = store or CircuitStore()
         self.cfg = cfg or ServiceConfig.from_env()
+        self.sched_cfg = sched_cfg or SchedulerConfig.from_env()
         self.crs_cache = CrsCache(self.cfg.crs_cache_size)
         self.queue = JobQueue(
             bound=self.cfg.queue_bound,
@@ -112,7 +116,19 @@ class ApiServer:
             history_bound=self.cfg.job_history,
         )
         self.executor = ProofExecutor(self.store, self.crs_cache, self.cfg)
-        self.pool = WorkerPool(self.queue, self.executor, self.cfg.workers)
+        # the batching scheduler (docs/SCHEDULER.md) is opt-in: with
+        # DG16_BATCH_MAX <= 1 the pool runs PR 2's per-job funnel exactly
+        self.scheduler = None
+        if self.sched_cfg.batch_max > 1:
+            from ..scheduler import BatchScheduler
+
+            self.scheduler = BatchScheduler(
+                self.executor, self.queue, self.sched_cfg
+            )
+        self.pool = WorkerPool(
+            self.queue, self.executor, self.cfg.workers,
+            scheduler=self.scheduler,
+        )
 
     # -- job plumbing --------------------------------------------------------
 
@@ -331,6 +347,11 @@ class ApiServer:
             {
                 "queue": self.queue.stats(),
                 "crsCache": self.crs_cache.stats(),
+                "scheduler": (
+                    self.scheduler.stats()
+                    if self.scheduler is not None
+                    else {"enabled": False}
+                ),
             }
         )
 

@@ -60,10 +60,13 @@ def king_combine_h(p, q, w, pp: PackedSharingParams) -> jnp.ndarray:
     """King-side combine: h = (p ⊙ q − w) at the ODD 2m-th roots (the
     CircomReduction semantics — in natural domain order the odd-coset
     entries are every second element), packed consecutively per party.
-    Inputs are clear (2m, 16) natural-order evaluation vectors; output is
-    (n, m/l, 16). Shared by the async star backend and the SPMD mesh
-    backend (parallel/mesh.py)."""
+    Inputs are clear (..., 2m, 16) natural-order evaluation vectors (extra
+    leading axes batch independent proofs — the scheduler's batched mesh
+    prover); output is (n, ..., m/l, 16). Shared by the async star backend
+    and the SPMD mesh backend (parallel/mesh.py)."""
     F = fr()
-    h_odd = F.sub(F.mul(p, q), w)[1::2]  # (m, 16)
-    packed = pp.pack_from_public(h_odd.reshape(-1, pp.l, 16))  # (m/l, n, 16)
-    return jnp.swapaxes(packed, 0, 1)
+    h_odd = F.sub(F.mul(p, q), w)[..., 1::2, :]  # (..., m, 16)
+    packed = pp.pack_from_public(
+        h_odd.reshape(h_odd.shape[:-2] + (-1, pp.l, 16))
+    )  # (..., m/l, n, 16)
+    return jnp.moveaxis(packed, -2, 0)

@@ -132,6 +132,85 @@ def build_mesh_prover(pp: PackedSharingParams, m: int, mesh: Mesh,
     return mesh_jit("mesh_prover_zk" if zk else "mesh_prover", mapped)
 
 
+def build_batch_mesh_prover(pp: PackedSharingParams, m: int, mesh: Mesh,
+                            batch: int):
+    """B same-circuit proofs as ONE SPMD program (scheduler/batch_prover.py).
+
+    The witness-dependent tensors carry a leading per-shard batch axis B
+    while the CRS shares stay un-batched (one shared packed CRS per
+    bucket): the FFT pipeline batches over (B, 3) through `_mesh_dfft`'s
+    extra-axes support, and the A/B/C MSMs run as one `_mesh_dmsm_batched`
+    of 3B rows against B broadcast copies of the three G1 query tables.
+    Deterministic (r = s = 0) cores only — exactly the service's proving
+    path, so each demuxed proof byte-matches the sequential route.
+
+    Returns a jitted f(qabc, a_share, ax_share, s, u, v, w) with global
+    shapes
+        qabc     (n, B, 3, m/l, 16)   stacked per-job qap a/b/c shares
+        a_share  (n, B, c_a, 16)      per-job packed witness shares
+        ax_share (n, B, c_w, 16)
+        s/u/v/w  as in MeshProverInputs (shared CRS, no batch axis)
+    producing (n, B, ...) replicated clear cores (pi_a, pi_b, pi_c)."""
+    logm = m.bit_length() - 1
+    dom = domain(m)
+    dom2 = domain(2 * m)
+    wpows_m = dom._live_wpows()
+    wpows_2m = dom2._live_wpows()
+    size_inv_m = dom._size_inv
+
+    def step(qabc, a_sh, ax_sh, s_q, u_q, v_q, w_q):
+        # --- ext_wit::h, batched over (B, 3) ----------------------------
+        coeffs = _mesh_dfft(
+            qabc, pp, logm, True, True, 2, False, False,
+            wpows_m, size_inv_m,
+        )  # (1, B, 3, 2m/l, 16)
+        evals = _mesh_dfft(
+            coeffs, pp, logm + 1, False, False, 1, False, True,
+            wpows_2m, None,
+        )  # king_clear: (B, 3, 2m, 16) clear, replicated
+        p, q, w = evals[:, 0], evals[:, 1], evals[:, 2]
+        h_share = _own_row(king_combine_h(p, q, w, pp))  # (1, B, m/l, 16)
+
+        # --- A, B, C: 3B G1 MSM rows over B copies of the shared bases --
+        cmax = max(s_q.shape[1], w_q.shape[1], u_q.shape[1])
+
+        def pads(x):  # scalars (B, c, 16) -> (B, cmax, 16); zero is inert
+            return jnp.pad(x, [(0, 0), (0, cmax - x.shape[1]), (0, 0)])
+
+        def padp(x):  # points (c, 3, 16) -> (cmax, 3, 16); INFINITY pad
+            extra = jnp.broadcast_to(
+                g1().infinity(), (cmax - x.shape[0], 3) + g1().elem_shape
+            )
+            return jnp.concatenate([x, extra], axis=0)
+
+        bases3 = jnp.stack(
+            [padp(s_q[0]), padp(w_q[0]), padp(u_q[0])], axis=0
+        )  # (3, cmax, 3)+elem
+        g1_bases = jnp.broadcast_to(
+            bases3[None], (batch,) + bases3.shape
+        ).reshape((3 * batch,) + bases3.shape[1:])
+        g1_scalars = jnp.stack(
+            [pads(a_sh[0]), pads(ax_sh[0]), pads(h_share[0])], axis=1
+        ).reshape(3 * batch, cmax, 16)
+        out = _mesh_dmsm_batched(
+            g1(), g1_bases[None], g1_scalars[None], pp
+        ).reshape((batch, 3) + g1().infinity().shape)
+        pi_a, c_w, c_u = out[:, 0], out[:, 1], out[:, 2]
+        vb = jnp.broadcast_to(v_q[0][None], (batch,) + v_q[0].shape)
+        pi_b = _mesh_dmsm_batched(g2(), vb[None], a_sh, pp)  # (B, 3, 2, 16)
+        pi_c = g1().add(c_w, c_u)
+        return pi_a[None], pi_b[None], pi_c[None]
+
+    sharded = P(AXIS)
+    mapped = shard_map(
+        step,
+        mesh,
+        in_specs=(sharded,) * 7,
+        out_specs=(sharded,) * 3,
+    )
+    return mesh_jit(f"mesh_prover_batch{batch}", mapped)
+
+
 def mesh_prove(pp, m, mesh, inp: MeshProverInputs):
     """One-shot helper: build, run, return clear (pi_a, pi_b, pi_c) from
     shard 0 (every shard holds identical values)."""

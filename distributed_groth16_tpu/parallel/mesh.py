@@ -71,6 +71,17 @@ def make_mesh(n_parties: int) -> Mesh:
     return Mesh(devs, (AXIS,))
 
 
+def make_mesh_from_devices(devices) -> Mesh:
+    """A parties mesh over an EXPLICIT device slice — the scheduler's
+    placement layer (scheduler/placement.py) partitions the inventory into
+    disjoint slices so independent batches prove concurrently instead of
+    serializing through jax.devices()[:n]."""
+    devs = np.array(list(devices))
+    if devs.size == 0:
+        raise RuntimeError("empty device slice")
+    return Mesh(devs, (AXIS,))
+
+
 def _own_row(stacked):
     """Per-shard slice of a replicated (n, ...) tensor -> (1, ...)."""
     idx = jax.lax.axis_index(AXIS)

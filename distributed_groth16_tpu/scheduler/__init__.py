@@ -1,0 +1,270 @@
+"""Batching scheduler: the layer between the job queue and the prover.
+
+PR 2's service funnels every proof through one `ProofExecutor`, so
+throughput is one job at a time regardless of queue depth or device
+count. This package adds the continuous-batching layer (Orca-style
+iteration batching from LLM serving, applied to zkSaaS-style proving —
+see docs/SCHEDULER.md):
+
+  bucketer.py      shape-bucketed admission: jobs group by
+                   (kind, circuit, curve, domain size, inputs, l) and a
+                   bucket releases at DG16_BATCH_MAX jobs or after
+                   DG16_BATCH_LINGER_MS
+  placement.py     device inventory sliced into independent prover
+                   meshes with asyncio leases — batches prove
+                   concurrently, not through one global mesh
+  batch_prover.py  B jobs as ONE SPMD mesh program over a shared packed
+                   CRS (models/groth16.build_batch_mesh_prover), demuxed
+                   to per-job results
+
+`BatchScheduler` below wires the three together for the worker pool:
+workers feed admitted jobs in, a linger loop releases expired buckets,
+and each released batch runs end-to-end under a mesh lease on a thread.
+Disabled (DG16_BATCH_MAX <= 1) the service behaves exactly as PR 2 built
+it — the scheduler is a pure addition, not a replacement.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..service.jobs import JobCancelled, JobState
+from ..utils.config import SchedulerConfig
+from .batch_prover import BatchProver, ProverCache, prove_batch  # noqa: F401
+from .bucketer import Batch, Bucketer, BucketKey  # noqa: F401
+from .placement import DevicePool, MeshLease  # noqa: F401
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "Batch",
+    "BatchProver",
+    "BatchScheduler",
+    "Bucketer",
+    "BucketKey",
+    "DevicePool",
+    "MeshLease",
+    "ProverCache",
+    "prove_batch",
+]
+
+_BATCHABLE_KINDS = ("prove", "mpc_prove")
+
+
+class BatchScheduler:
+    """Event-loop-side orchestrator: admission -> bucket -> lease -> prove.
+
+    Backpressure: `offer` blocks the feeding worker once `max_inflight`
+    jobs sit in buckets or batches, so the queue refills and the 429
+    admission bound (PR 2) keeps rejecting instead of the scheduler
+    swallowing the backlog.
+    """
+
+    def __init__(self, executor, queue, cfg: SchedulerConfig | None = None,
+                 devices=None):
+        self.executor = executor
+        self.queue = queue
+        self.cfg = cfg or SchedulerConfig.from_env()
+        self.bucketer = Bucketer(
+            self.cfg.batch_max, self.cfg.batch_linger_ms / 1000.0
+        )
+        self.devices = DevicePool(devices, self.cfg.max_meshes)
+        self.batch_prover = BatchProver(executor)
+        self._meta: dict[str, tuple[int, int]] = {}  # cid -> (m, num_inputs)
+        self._inflight = asyncio.Semaphore(
+            self.cfg.max_inflight or 4 * self.cfg.batch_max
+        )
+        self._wake: asyncio.Event | None = None
+        self._runner: asyncio.Task | None = None
+        self._batch_tasks: set[asyncio.Task] = set()
+        self.batches_dispatched = 0
+        self.jobs_batched = 0
+
+    # -- lifecycle (worker pool start/stop) ----------------------------------
+
+    async def start(self) -> None:
+        self._wake = asyncio.Event()
+        self._runner = asyncio.create_task(
+            self._linger_loop(), name="dg16-scheduler"
+        )
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            self._runner.cancel()
+            await asyncio.gather(self._runner, return_exceptions=True)
+            self._runner = None
+        # jobs still lingering never got a batch — terminal-fail them like
+        # the pool fails undrained QUEUED jobs, so nothing waits forever
+        for batch in self.bucketer.flush():
+            for job in batch.jobs:
+                if job.state is JobState.QUEUED:
+                    job.mark_failed(RuntimeError("service shutting down"))
+                    self.queue.on_finished(job)
+                self._inflight.release()
+        # in-flight batches hold real proving threads — let them finish
+        # (a proof that completes during shutdown is a result, not a
+        # failure; same contract as WorkerPool.stop)
+        if self._batch_tasks:
+            await asyncio.gather(*self._batch_tasks, return_exceptions=True)
+
+    # -- admission (worker side) ---------------------------------------------
+
+    def eligible(self, job) -> bool:
+        """Can this job ride the batched mesh path? Needs a batchable
+        kind and an inventory slice of 4l devices; anything else falls
+        back to the per-job executor funnel."""
+        return (
+            self.cfg.batch_max > 1
+            and job.kind in _BATCHABLE_KINDS
+            and self.devices.capacity(4 * job.l) >= 1
+        )
+
+    async def offer(self, job) -> None:
+        """Admit one popped job into its bucket. Blocks (backpressure)
+        while the scheduler is saturated. The batch-admission cancel
+        check lives here and at release: a job cancelled while QUEUED —
+        including while lingering in a bucket — never enters a batch.
+
+        Cancellation-safe: the job is already popped from the queue, so
+        if the feeding worker task is torn down mid-offer (pool stop
+        while parked on the saturation semaphore or the metadata thread
+        hop) the job must not be stranded QUEUED — it gets the same
+        terminal fail the pool gives undrained jobs at shutdown."""
+        held = False
+        try:
+            await self._inflight.acquire()
+            held = True
+            if job.state is not JobState.QUEUED or job.cancel_requested:
+                return
+            try:
+                key = await asyncio.to_thread(self._key_of, job)
+            except Exception as e:  # noqa: BLE001 — bad circuit metadata
+                job.mark_failed(e)
+                self.queue.on_finished(job)
+                return
+            # re-check after the thread hop: a DELETE may have landed
+            # while the metadata loaded
+            if job.state is not JobState.QUEUED or job.cancel_requested:
+                return
+            batch = self.bucketer.add(job, key)
+            held = False  # the permit now rides the batch lifecycle
+            if batch is not None:
+                self._spawn(batch)
+            elif self._wake is not None:
+                self._wake.set()
+        except asyncio.CancelledError:
+            if job.state is JobState.QUEUED:
+                job.mark_failed(RuntimeError("service shutting down"))
+                self.queue.on_finished(job)
+            raise
+        finally:
+            if held:
+                self._inflight.release()
+
+    def _key_of(self, job) -> BucketKey:
+        meta = self._meta.get(job.circuit_id)
+        if meta is None:
+            r1cs, pk = self.executor.store.load(job.circuit_id)
+            meta = (pk.domain_size, r1cs.num_instance)
+            self._meta[job.circuit_id] = meta
+        return BucketKey(
+            kind=job.kind,
+            circuit_id=job.circuit_id,
+            curve="bn254",
+            domain_size=meta[0],
+            num_inputs=meta[1],
+            l=job.l,
+        )
+
+    # -- release + execution -------------------------------------------------
+
+    async def _linger_loop(self) -> None:
+        while True:
+            deadline = self.bucketer.next_deadline()
+            timeout = (
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            for batch in self.bucketer.pop_expired():
+                self._spawn(batch)
+
+    def _spawn(self, batch: Batch) -> None:
+        task = asyncio.create_task(
+            self._run_batch(batch), name=f"dg16-batch-{batch.key.label}"
+        )
+        self._batch_tasks.add(task)
+        task.add_done_callback(self._batch_tasks.discard)
+
+    def _admit(self, jobs) -> list:
+        """Batch-admission cancel filter: DELETE on a still-QUEUED job
+        flipped it to terminal CANCELLED (queue.cancel) — it must never
+        execute. Dropped jobs are already terminal; only their inflight
+        permit needs returning."""
+        admitted = []
+        for job in jobs:
+            if job.state is JobState.QUEUED and not job.cancel_requested:
+                admitted.append(job)
+            else:
+                self._inflight.release()
+        return admitted
+
+    async def _run_batch(self, batch: Batch) -> None:
+        jobs = self._admit(batch.jobs)
+        if not jobs:
+            return
+        lease = await self.devices.acquire(batch.key.n_parties)
+        # re-filter: the lease wait can last a whole prior batch, and a
+        # DELETE landing in that window already made the job terminal —
+        # mark_running after it would resurrect a CANCELLED job
+        jobs = self._admit(jobs)
+        if not jobs:
+            lease.release()
+            return
+        try:
+            for job in jobs:
+                job.mark_running()
+                self.queue.on_started(job)
+            try:
+                outcomes = await asyncio.to_thread(
+                    self.batch_prover.run_batch, jobs, batch.key, lease.mesh
+                )
+            except BaseException as e:  # noqa: BLE001 — never lose a job
+                outcomes = [(job, e) for job in jobs]
+        finally:
+            lease.release()
+        for job, out in outcomes:
+            if isinstance(out, JobCancelled):
+                job.mark_cancelled()
+            elif isinstance(out, BaseException):
+                log.warning("batched job %s failed: %s", job.id, out)
+                job.mark_failed(out)
+            else:
+                job.mark_done(out)
+            self.queue.on_finished(job)
+            self._inflight.release()
+        self.batches_dispatched += 1
+        self.jobs_batched += len(jobs)
+
+    # -- /stats --------------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "enabled": True,
+            "batchMax": self.cfg.batch_max,
+            "lingerMs": self.cfg.batch_linger_ms,
+            "batchesDispatched": self.batches_dispatched,
+            "jobsBatched": self.jobs_batched,
+            "bucketOccupancy": self.bucketer.occupancy(),
+            "placement": self.devices.stats(),
+            "proverCache": {
+                "hits": self.batch_prover.provers.hits,
+                "misses": self.batch_prover.provers.misses,
+            },
+        }

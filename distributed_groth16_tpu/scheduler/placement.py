@@ -1,0 +1,162 @@
+"""Device inventory + mesh slicing — the placement half of the scheduler.
+
+The inventory (default: `jax.devices()`) is partitioned into disjoint
+contiguous slices of `n_parties` devices, each backing one independent
+prover mesh. A batch holds a `MeshLease` on one slice for its whole
+proving round, so two batches of a 4-party circuit prove CONCURRENTLY on
+an 8-device host instead of serializing through `jax.devices()[:n]` —
+multi-mesh placement is the throughput lever the single `ProofExecutor`
+funnel (PR 2) lacked.
+
+Leases are asyncio-native (acquired on the event loop, the proving work
+itself runs on a thread): an `asyncio.Condition` parks waiters when every
+slice is busy, and `release()` wakes exactly them. The Mesh object is
+built lazily per lease slice and memoized, so lease accounting is testable
+with fake device objects and repeated leases don't rebuild meshes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..telemetry import metrics as _tm
+
+_REG = _tm.registry()
+_MESH_IN_USE = _REG.gauge(
+    "scheduler_mesh_leases_in_use", "Mesh slices currently leased to a batch"
+)
+_MESH_CAPACITY = _REG.gauge(
+    "scheduler_mesh_capacity", "Distinct prover meshes the inventory supports",
+    ("n_parties",),
+)
+_MESH_UTIL = _REG.gauge(
+    "scheduler_mesh_utilization",
+    "Busy fraction of the device inventory (leased devices / total)",
+)
+_MESH_WAIT = _REG.histogram(
+    "scheduler_mesh_wait_seconds",
+    "Seconds a released batch waited for a free mesh slice",
+)
+
+
+class MeshLease:
+    """Exclusive hold on one device slice; `mesh` builds the parties Mesh
+    on first use. Always release() (the scheduler does so in a finally)."""
+
+    def __init__(self, pool: "DevicePool", slot: int, devices: list):
+        self.pool = pool
+        self.slot = slot
+        self.devices = devices
+        self._mesh = None
+        self._released = False
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = self.pool._mesh_for(self.slot, self.devices)
+        return self._mesh
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self.pool._release(self)
+
+
+class DevicePool:
+    def __init__(self, devices=None, max_meshes: int = 0):
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+        self.devices = list(devices)
+        self.max_meshes = max_meshes  # 0 = as many as the inventory allows
+        # busy DEVICE indices (not slot numbers): mixed party counts lease
+        # concurrently, and a slot number means a different device range
+        # per n_parties — only the device set itself is collision-safe
+        self._busy: set[int] = set()
+        self._leases = 0
+        self._cond = asyncio.Condition()
+        self._meshes: dict[tuple, object] = {}  # (slot, n) -> Mesh
+
+    def capacity(self, n_parties: int) -> int:
+        """How many disjoint n_parties-meshes the inventory supports."""
+        if n_parties <= 0:
+            return 0
+        cap = len(self.devices) // n_parties
+        if self.max_meshes > 0:
+            cap = min(cap, self.max_meshes)
+        return cap
+
+    def _free_slot(self, n_parties: int) -> int | None:
+        if self.max_meshes > 0 and self._leases >= self.max_meshes:
+            return None
+        for slot in range(len(self.devices) // n_parties):
+            lo, hi = slot * n_parties, (slot + 1) * n_parties
+            if all(i not in self._busy for i in range(lo, hi)):
+                return slot
+        return None
+
+    async def acquire(self, n_parties: int) -> MeshLease:
+        """Lease a free slice of n_parties devices, waiting if every slice
+        is busy. Raises RuntimeError when the inventory can NEVER satisfy
+        the request (callers gate on capacity() at admission)."""
+        if self.capacity(n_parties) < 1:
+            raise RuntimeError(
+                f"no mesh slice of {n_parties} devices available "
+                f"(inventory: {len(self.devices)})"
+            )
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        async with self._cond:
+            while True:
+                slot = self._free_slot(n_parties)
+                if slot is not None:
+                    lo, hi = slot * n_parties, (slot + 1) * n_parties
+                    self._busy.update(range(lo, hi))
+                    self._leases += 1
+                    self._update_gauges(n_parties)
+                    _MESH_WAIT.observe(loop.time() - t0)
+                    return MeshLease(self, slot, self.devices[lo:hi])
+                await self._cond.wait()
+
+    def _release(self, lease: "MeshLease") -> None:
+        lo = lease.slot * len(lease.devices)
+        self._busy.difference_update(range(lo, lo + len(lease.devices)))
+        self._leases -= 1
+        _MESH_IN_USE.set(self._leases)
+        if self.devices:
+            _MESH_UTIL.set(len(self._busy) / len(self.devices))
+
+        async def _notify():
+            async with self._cond:
+                self._cond.notify_all()
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            loop.create_task(_notify())
+
+    def _update_gauges(self, n_parties: int) -> None:
+        _MESH_IN_USE.set(self._leases)
+        _MESH_CAPACITY.labels(n_parties=n_parties).set(self.capacity(n_parties))
+        if self.devices:
+            _MESH_UTIL.set(len(self._busy) / len(self.devices))
+
+    def _mesh_for(self, slot: int, devices: list):
+        key = (slot, len(devices))
+        mesh = self._meshes.get(key)
+        if mesh is None:
+            from ..parallel.mesh import make_mesh_from_devices
+
+            mesh = self._meshes[key] = make_mesh_from_devices(devices)
+        return mesh
+
+    def stats(self) -> dict:
+        return {
+            "devices": len(self.devices),
+            "busyDevices": len(self._busy),
+            "leasesInUse": self._leases,
+            "maxMeshes": self.max_meshes,
+        }

@@ -123,6 +123,22 @@ class ProofJob:
     def request_cancel(self) -> None:
         self._cancel_flag.set()
 
+    @property
+    def cancel_requested(self) -> bool:
+        """True once DELETE landed — read by the batching scheduler at
+        batch-admission time (a job cancelled while lingering in a bucket
+        must never enter a batch) and by the executor's check_cancel."""
+        return self._cancel_flag.is_set()
+
+    @property
+    def bucket(self) -> str:
+        """Coarse bucket label — the runtime-EMA key (service/queue.py).
+        Cheap on purpose (no store lookup): kind + circuit + packing
+        factor determine the work shape closely enough for retryAfter
+        estimation; the scheduler's full BucketKey adds the shape fields
+        it must not guess."""
+        return f"{self.kind}:{self.circuit_id}:l{self.l}"
+
     def _finish(self) -> None:
         self.finished_at = time.time()
         # the submission payload (witness bytes, up to the 100 MB body cap)

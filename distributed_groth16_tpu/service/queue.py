@@ -8,8 +8,10 @@ both the `/stats` route and the retryAfter hint on rejections.
 Admission control: at most `bound` jobs may be waiting (QUEUED). A submit
 past that raises `QueueFullError` carrying a `retry_after_s` hint — the
 API maps it to HTTP 429 — estimated as (depth / workers) x the observed
-mean job runtime, falling back to a configured constant before any job
-has completed.
+mean runtime of jobs in the rejected job's BUCKET (kind + circuit + l,
+`ProofJob.bucket`), so a slow big circuit doesn't inflate hints for small
+ones; unknown buckets fall back to the cross-bucket mean, and cold start
+to a configured constant.
 
 Everything here runs on the event-loop thread except `record_timings`
 (PhaseTimings is internally locked), so plain attributes suffice.
@@ -39,7 +41,10 @@ _DEPTH = _REG.gauge("job_queue_depth", "Jobs currently waiting (QUEUED)")
 _RUNNING = _REG.gauge("job_queue_running", "Jobs currently executing")
 _RUNTIME_EMA = _REG.gauge(
     "job_runtime_ema_seconds",
-    "Exponential moving average of job runtime — the retryAfter estimator",
+    "Exponential moving average of job runtime, per bucket — the "
+    "retryAfter estimator (a slow big circuit must not inflate hints "
+    "for small ones)",
+    ("bucket",),
 )
 _QUEUE_WAIT = _REG.histogram(
     "job_queue_wait_seconds", "Seconds a job waited QUEUED before starting"
@@ -89,7 +94,10 @@ class JobQueue:
         self.completed = 0
         self.failed = 0
         self.cancelled = 0
-        self._runtime_ema_s: float | None = None
+        # runtime EMA per bucket (jobs.ProofJob.bucket): retryAfter hints
+        # are estimated from jobs of the SAME shape, so a slow big circuit
+        # doesn't inflate the hint for a small one queued behind it
+        self._runtime_ema_s: dict[str, float] = {}
         self.aggregate_timings = PhaseTimings()
 
     # -- submission (request path) ------------------------------------------
@@ -99,7 +107,9 @@ class JobQueue:
         if depth >= self.bound:
             self.rejected += 1
             _REJECTED.inc()
-            raise QueueFullError(self.bound, depth, self.retry_after_hint())
+            raise QueueFullError(
+                self.bound, depth, self.retry_after_hint(job.bucket)
+            )
         self.jobs[job.id] = job
         self._queued_ids.add(job.id)
         self._q.put_nowait(job)
@@ -108,14 +118,19 @@ class JobQueue:
         _DEPTH.set(len(self._queued_ids))
         return job
 
-    def retry_after_hint(self) -> float:
+    def retry_after_hint(self, bucket: str | None = None) -> float:
         """Seconds until a queue slot plausibly frees: one full drain of
         the current backlog through the worker pool at the observed mean
-        job runtime."""
-        if self._runtime_ema_s is None:
+        runtime of jobs in the SAME bucket. Unknown bucket (or none
+        given) falls back to the mean across buckets; cold start falls
+        back to the configured constant."""
+        ema = self._runtime_ema_s.get(bucket) if bucket is not None else None
+        if ema is None and self._runtime_ema_s:
+            ema = sum(self._runtime_ema_s.values()) / len(self._runtime_ema_s)
+        if ema is None:
             return self.default_retry_after_s
         drains = math.ceil((len(self._queued_ids) + 1) / self.workers)
-        return max(1.0, drains * self._runtime_ema_s)
+        return max(1.0, drains * ema)
 
     # -- worker side ---------------------------------------------------------
 
@@ -143,12 +158,12 @@ class JobQueue:
         _FINISHED.labels(state=job.state.value).inc()
         rt = job.runtime_s
         if rt is not None:
-            self._runtime_ema_s = (
-                rt
-                if self._runtime_ema_s is None
-                else 0.7 * self._runtime_ema_s + 0.3 * rt
+            b = job.bucket
+            prev = self._runtime_ema_s.get(b)
+            self._runtime_ema_s[b] = (
+                rt if prev is None else 0.7 * prev + 0.3 * rt
             )
-            _RUNTIME_EMA.set(self._runtime_ema_s)
+            _RUNTIME_EMA.labels(bucket=b).set(self._runtime_ema_s[b])
             _JOB_SECONDS.labels(kind=job.kind).observe(rt)
         self.aggregate_timings.merge(job.timings)
         self._note_terminal(job)
@@ -210,9 +225,15 @@ class JobQueue:
             "completed": self.completed,
             "failed": self.failed,
             "cancelled": self.cancelled,
-            # the runtime EMA feeding retry_after_hint, exposed both here
-            # and as the job_runtime_ema_seconds gauge on /metrics; None
-            # until the first job completes (cold start)
-            "meanRuntimeS": self._runtime_ema_s,
+            # the runtime EMAs feeding retry_after_hint, exposed both here
+            # and as the job_runtime_ema_seconds{bucket} gauge on /metrics;
+            # meanRuntimeS keeps its pre-bucketing shape (None until the
+            # first job completes) as the cross-bucket mean
+            "meanRuntimeS": (
+                sum(self._runtime_ema_s.values()) / len(self._runtime_ema_s)
+                if self._runtime_ema_s
+                else None
+            ),
+            "runtimeEmaByBucket": dict(self._runtime_ema_s),
             "phases": self.aggregate_timings.as_millis(),
         }

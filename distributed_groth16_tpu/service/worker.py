@@ -64,7 +64,10 @@ class ProofExecutor:
 
     # -- witness -------------------------------------------------------------
 
-    def _witness(self, job: ProofJob, r1cs) -> list[int]:
+    def resolve_witness(self, job: ProofJob, r1cs) -> list[int]:
+        """Resolve + validate a job's witness assignment. Public because
+        the batching scheduler's BatchProver resolves each batched job's
+        witness through the same path (scheduler/batch_prover.py)."""
         fields = job.fields
         if "witness_file" in fields:
             z = read_wtns(fields["witness_file"])
@@ -121,7 +124,7 @@ class ProofExecutor:
             r1cs, pk = self.store.load(job.circuit_id)
         job.check_cancel()
         with phase("witness", timings):
-            z = self._witness(job, r1cs)
+            z = self.resolve_witness(job, r1cs)
         job.check_cancel()
         F = fr()
         z_mont = F.encode(z)
@@ -176,15 +179,27 @@ class ProofExecutor:
 
 
 class WorkerPool:
-    """DG16_SERVICE_WORKERS asyncio tasks draining the JobQueue."""
+    """DG16_SERVICE_WORKERS asyncio tasks draining the JobQueue.
 
-    def __init__(self, queue: JobQueue, executor: ProofExecutor, workers: int = 2):
+    With a batching scheduler attached (DG16_BATCH_MAX > 1 —
+    scheduler/BatchScheduler, docs/SCHEDULER.md) the workers become
+    FEEDERS for batch-eligible jobs: popped jobs are offered to the
+    bucketer and the scheduler runs released batches end-to-end under
+    mesh leases, so proving concurrency is bounded by mesh slices rather
+    than worker count. Ineligible jobs (and every job when the scheduler
+    is absent) take the per-job executor path below, unchanged."""
+
+    def __init__(self, queue: JobQueue, executor: ProofExecutor,
+                 workers: int = 2, scheduler=None):
         self.queue = queue
         self.executor = executor
         self.workers = max(1, workers)
+        self.scheduler = scheduler
         self._tasks: list[asyncio.Task] = []
 
     async def start(self) -> None:
+        if self.scheduler is not None:
+            await self.scheduler.start()
         for i in range(self.workers):
             self._tasks.append(
                 asyncio.create_task(self._worker(i), name=f"dg16-worker-{i}")
@@ -195,6 +210,10 @@ class WorkerPool:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
+        if self.scheduler is not None:
+            # flushes still-lingering bucketed jobs to a terminal state
+            # and waits out in-flight batches (their proofs are results)
+            await self.scheduler.stop()
         # jobs still QUEUED will never get a worker now — transition them
         # so sync waiters and status pollers see a terminal state instead
         # of QUEUED forever (and of stalling graceful shutdown)
@@ -207,6 +226,12 @@ class WorkerPool:
             job = await self.queue.get()
             if job.state is not JobState.QUEUED:
                 continue  # cancelled while queued — never runs
+            if self.scheduler is not None and self.scheduler.eligible(job):
+                # feed the bucketer; `offer` blocks when the scheduler is
+                # saturated (backpressure: the queue refills and 429s
+                # keep firing at the admission bound)
+                await self.scheduler.offer(job)
+                continue
             job.mark_running()
             self.queue.on_started(job)
             fut = asyncio.ensure_future(

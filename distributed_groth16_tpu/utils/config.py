@@ -119,6 +119,48 @@ class ServiceConfig:
         )
 
 
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Batching-scheduler knobs (scheduler/, docs/SCHEDULER.md). Every
+    field has a DG16_* env override.
+
+      * batch_max — jobs per bucket before a batch releases immediately.
+        <= 1 DISABLES the scheduler entirely: the service runs PR 2's
+        per-job executor funnel, byte-for-byte.
+      * batch_linger_ms — how long a partially-filled bucket waits for
+        batchmates before releasing anyway: the latency a lone job pays
+        for amortization. 0 releases on the next scheduler tick.
+      * max_meshes — cap on concurrently leased prover meshes. 0 = as
+        many disjoint 4l-device slices as the inventory supports.
+      * max_inflight — backpressure bound on jobs the scheduler holds
+        (bucketed + batching). Workers stop feeding past it, so the
+        queue refills and the 429 admission bound stays meaningful.
+        0 = 4 x batch_max.
+    """
+
+    batch_max: int = 1
+    batch_linger_ms: float = 50.0
+    max_meshes: int = 0
+    max_inflight: int = 0
+
+    @staticmethod
+    def from_env() -> "SchedulerConfig":
+        def i(name: str, default: int) -> int:
+            v = os.environ.get(name)
+            return int(v) if v not in (None, "") else default
+
+        def f(name: str, default: float) -> float:
+            v = os.environ.get(name)
+            return float(v) if v not in (None, "") else default
+
+        return SchedulerConfig(
+            batch_max=i("DG16_BATCH_MAX", 1),
+            batch_linger_ms=f("DG16_BATCH_LINGER_MS", 50.0),
+            max_meshes=i("DG16_SCHED_MESHES", 0),
+            max_inflight=i("DG16_SCHED_INFLIGHT", 0),
+        )
+
+
 @dataclass
 class Opt:
     id: int  # party id (0 = king)

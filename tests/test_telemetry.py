@@ -429,21 +429,40 @@ def test_retry_after_cold_start_falls_back_then_tracks_ema():
         # the EMA is explicitly absent from /stats
         assert q.retry_after_hint() == 7.5
         assert q.stats()["meanRuntimeS"] is None
-        job = ProofJob(kind="prove", circuit_id="c", fields={})
-        q.submit(job)
+
+        def finish(kind, circuit_id, runtime_s):
+            job = ProofJob(kind=kind, circuit_id=circuit_id, fields={})
+            q.submit(job)
+            job.mark_running()
+            q.on_started(job)
+            job.mark_done({})
+            job.finished_at = job.started_at + runtime_s  # deterministic
+            q.on_finished(job)
+            return job
+
+        job = finish("prove", "c", 10.0)
         await q.get()
-        job.mark_running()
-        q.on_started(job)
-        job.mark_done({})
-        job.finished_at = job.started_at + 10.0  # deterministic runtime
-        q.on_finished(job)
         assert q.stats()["meanRuntimeS"] == pytest.approx(10.0)
         # hint = ceil((depth + 1) / workers) * ema
+        assert q.retry_after_hint(job.bucket) == pytest.approx(10.0)
+        # unknown bucket falls back to the cross-bucket mean (so does the
+        # bucket-less legacy spelling)
+        assert q.retry_after_hint("prove:other:l2") == pytest.approx(10.0)
         assert q.retry_after_hint() == pytest.approx(10.0)
-        # the EMA is exposed as a gauge on the registry
-        assert REG.gauge("job_runtime_ema_seconds").value == pytest.approx(
-            10.0
-        )
+        # the EMA is exposed as a per-bucket gauge on the registry
+        gauge = REG.gauge("job_runtime_ema_seconds", labelnames=("bucket",))
+        assert gauge.labels(bucket=job.bucket).value == pytest.approx(10.0)
+
+        # EMAs are KEYED by bucket: a slow big circuit must not inflate
+        # the hint for a small one
+        slow = finish("mpc_prove", "big", 100.0)
+        await q.get()
+        assert q.retry_after_hint(job.bucket) == pytest.approx(10.0)
+        assert q.retry_after_hint(slow.bucket) == pytest.approx(100.0)
+        by_bucket = q.stats()["runtimeEmaByBucket"]
+        assert by_bucket[job.bucket] == pytest.approx(10.0)
+        assert by_bucket[slow.bucket] == pytest.approx(100.0)
+        assert gauge.labels(bucket=slow.bucket).value == pytest.approx(100.0)
 
     asyncio.run(run())
 
