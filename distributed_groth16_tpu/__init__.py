@@ -23,14 +23,15 @@ import jax
 # (utils/cache.py): XLA:CPU AOT entries from a host with different vector
 # features can SIGILL on load, and driver rounds hop between hosts.
 try:
-    if os.environ.get("DG16_NO_JAX_CACHE"):
+    from .utils import config as _config
+
+    if _config.env_flag("DG16_NO_JAX_CACHE"):
         from .utils.cache import disable_compile_cache
 
         disable_compile_cache(jax)
-    elif "DG16_JAX_CACHE" in os.environ:
+    elif cache_dir := _config.env_str("DG16_JAX_CACHE"):
         jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.abspath(os.environ["DG16_JAX_CACHE"]),
+            "jax_compilation_cache_dir", os.path.abspath(cache_dir)
         )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     else:

@@ -18,13 +18,14 @@ from ..frontend.r1cs import R1CS
 from ..frontend.readers import read_r1cs
 from ..models.groth16.keys import ProvingKey
 from ..models.groth16.setup import setup
+from ..utils import config as _config
 
 SETUP_SEED = 42
 
 
 class CircuitStore:
     def __init__(self, root: str | None = None):
-        self.root = root or os.environ.get("DG16_STORE", "./circuit_store")
+        self.root = root or _config.env_str("DG16_STORE", "./circuit_store")
         os.makedirs(self.root, exist_ok=True)
 
     def _dir(self, circuit_id: str) -> str:

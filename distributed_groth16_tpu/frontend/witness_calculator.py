@@ -65,11 +65,11 @@ class WitnessCalculator:
         """engine: "auto" (C tier when buildable — ~100x the throughput of
         the Python VM — else Python), "c", or "python". DG16_NO_CWASM=1
         forces the Python VM globally."""
-        import os
+        from ..utils import config as _config
 
         self.module = Module(wasm_bytes)
         use_c = engine == "c" or (
-            engine == "auto" and not os.environ.get("DG16_NO_CWASM")
+            engine == "auto" and not _config.env_flag("DG16_NO_CWASM")
         )
         self.inst = None
         self._auto = engine == "auto"

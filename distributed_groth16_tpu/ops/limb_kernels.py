@@ -24,12 +24,12 @@ for BN254 Fq; the same machinery can host BLS12-381's base field.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import config as _config
 from .constants import LIMB_BITS, N_LIMBS, Q, to_limbs
 
 MASK = 0xFFFF
@@ -71,7 +71,7 @@ def _pallas_roll_mode() -> str:
     return _ROLL_MODE
 
 
-_ROLL_MODE = os.environ.get("DG16_PALLAS_ROLL", "fori")
+_ROLL_MODE = _config.env_str("DG16_PALLAS_ROLL", "fori")
 
 
 def kernel_roll_mode():

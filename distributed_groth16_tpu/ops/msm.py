@@ -129,12 +129,12 @@ def _tree_group(curve: CurvePoints, n: int):
     for the generic row-major path. TPU backends route every supported
     curve here — the Pallas fast path; DG16_FORCE_TREE_MSM=1 forces it
     anywhere (tests exercise the identical XLA bodies on CPU)."""
-    import os
+    from ..utils import config as _config
 
     factory = _limb_group_for(curve)
     if factory is None:
         return None
-    if os.environ.get("DG16_FORCE_TREE_MSM") == "1":
+    if _config.env_flag("DG16_FORCE_TREE_MSM"):
         return factory()
     from .limb_kernels import use_pallas
 

@@ -203,9 +203,9 @@ def _limb_ntt_ok(n: int) -> bool:
     tests exercise the identical XLA bodies on CPU). Small transforms keep
     the row-major fori core: the limb path's layout transposes only pay
     off when the butterfly work dominates."""
-    import os
+    from ..utils import config as _config
 
-    if os.environ.get("DG16_FORCE_LIMB_NTT") == "1":
+    if _config.env_flag("DG16_FORCE_LIMB_NTT"):
         return True
     from .limb_kernels import use_pallas
 

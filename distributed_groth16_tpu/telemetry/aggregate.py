@@ -37,13 +37,13 @@ same zero-overhead contract as the rest of the spine
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 
 from . import metrics as _tm
 from . import tracing as _tracing
+from ..utils import config as _config
 
 # The telemetry clock: the SAME clock span timestamps use (tracing.py
 # stamps `ts` from time.perf_counter), so a ClockSync offset estimated
@@ -73,7 +73,7 @@ _CLOCK_RTT = _REG.gauge(
     ("peer",),
 )
 
-_enabled = os.environ.get("DG16_AGG", "").lower() not in ("", "0", "false")
+_enabled = _config.env_flag("DG16_AGG", False)
 
 _agg_buffer: "_tracing.TraceBuffer | None" = None
 _AGGREGATOR: "TraceAggregator | None" = None

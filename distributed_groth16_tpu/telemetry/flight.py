@@ -31,6 +31,7 @@ from collections import deque
 
 from . import metrics as _tm
 from . import tracing as _tracing
+from ..utils import config as _config
 
 _REG = _tm.registry()
 _DUMPS = _REG.counter(
@@ -214,7 +215,7 @@ def dump_soon(
 
 def configure_from_env() -> None:
     """Honor DG16_FLIGHT_DIR: install the recorder pointed at it."""
-    d = os.environ.get("DG16_FLIGHT_DIR", "")
+    d = _config.env_str("DG16_FLIGHT_DIR")
     if d:
         configure(d)
 

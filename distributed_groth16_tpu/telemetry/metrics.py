@@ -27,10 +27,11 @@ default because it is allocation-free).
 
 from __future__ import annotations
 
-import os
 import threading
 from bisect import bisect_left
 from typing import Sequence
+
+from ..utils import config as _config
 
 INF = float("inf")
 
@@ -41,7 +42,7 @@ DEFAULT_TIME_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, INF,
 )
 
-_ENABLED = os.environ.get("DG16_METRICS", "1").lower() not in ("0", "false")
+_ENABLED = _config.env_flag("DG16_METRICS", True)
 
 
 def set_enabled(on: bool) -> None:

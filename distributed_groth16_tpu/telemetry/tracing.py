@@ -35,11 +35,12 @@ import asyncio
 import atexit
 import itertools
 import json
-import os
 import threading
 import time
 from contextlib import contextmanager
 from contextvars import ContextVar
+
+from ..utils import config as _config
 
 _CURRENT: ContextVar["Span | None"] = ContextVar("dg16_span", default=None)
 _BUFFER: ContextVar["TraceBuffer | None"] = ContextVar(
@@ -327,7 +328,7 @@ def flush_global(path: str | None = None) -> str | None:
 
 def configure_from_env() -> None:
     """Honor DG16_TRACE_OUT: install the global buffer pointed at it."""
-    path = os.environ.get("DG16_TRACE_OUT", "")
+    path = _config.env_str("DG16_TRACE_OUT")
     if path:
         enable_global(path)
 

@@ -74,7 +74,9 @@ def setup_compile_cache(
     here are bench/scripts/service entry points, where a crash is retryable
     and the minutes-scale kernel compiles make caching worth the risk.
     """
-    if os.environ.get("DG16_NO_JAX_CACHE"):
+    from . import config as _config
+
+    if _config.env_flag("DG16_NO_JAX_CACHE"):
         disable_compile_cache(jax)
         return ""
     # v4: versioned partition — earlier partitions can hold entries whose

@@ -12,6 +12,94 @@ import argparse
 import os
 from dataclasses import dataclass
 
+# -- the DG16_* knob registry ------------------------------------------------
+# THE authoritative config surface: every DG16_* environment knob anywhere
+# in the repo is declared here (name -> one-line operator doc), and package
+# code reads knobs ONLY through the typed accessors below — dg16lint's
+# DG103 rule fails the build on a raw os.environ read elsewhere, and on a
+# knob declared here but documented in neither README.md nor docs/*.md.
+# (The structured NetConfig/ServiceConfig/SchedulerConfig dataclasses below
+# read through the same accessors.)
+
+KNOBS: dict[str, str] = {
+    # transport (docs/ROBUSTNESS.md)
+    "DG16_NET_OP_TIMEOUT_S": "per-collective send/recv deadline, <=0 off",
+    "DG16_NET_CONNECT_TIMEOUT_S": "total bring-up budget (dial + barrier)",
+    "DG16_NET_CONNECT_BASE_DELAY_S": "client redial backoff base",
+    "DG16_NET_CONNECT_MAX_DELAY_S": "client redial backoff cap",
+    "DG16_NET_CONNECT_JITTER": "redial backoff jitter fraction",
+    "DG16_NET_HEARTBEAT_S": "idle-link keepalive period, <=0 off",
+    "DG16_NET_IDLE_TIMEOUT_S": "declare a silent peer dead after this",
+    # service (docs/SERVICE.md)
+    "DG16_SERVICE_WORKERS": "worker pool size (concurrent proofs)",
+    "DG16_SERVICE_QUEUE_BOUND": "admission bound before 429",
+    "DG16_SERVICE_CRS_CACHE": "packed-CRS LRU entries, 0 off",
+    "DG16_SERVICE_ROUND_RETRIES": "transient-fault re-runs per MPC round",
+    "DG16_SERVICE_RETRY_AFTER_S": "cold-start retryAfter hint seconds",
+    "DG16_SERVICE_JOB_HISTORY": "terminal jobs kept addressable",
+    # batching scheduler (docs/SCHEDULER.md)
+    "DG16_BATCH_MAX": "jobs per batch; <=1 disables the scheduler",
+    "DG16_BATCH_LINGER_MS": "partial-bucket wait for batchmates",
+    "DG16_SCHED_MESHES": "cap on concurrently leased prover meshes",
+    "DG16_SCHED_INFLIGHT": "scheduler backpressure bound",
+    # telemetry (docs/OBSERVABILITY.md)
+    "DG16_METRICS": "metrics kill switch (default on; 0/false off)",
+    "DG16_TRACE": "print Start:/End: phase lines",
+    "DG16_TRACE_OUT": "record all spans, Chrome trace file at exit",
+    "DG16_AGG": "star-wide trace aggregation plane (default off)",
+    "DG16_FLIGHT_DIR": "flight-recorder post-mortem directory",
+    "DG16_FLIGHT_ARTIFACT_DIR": "chaos-suite flight-dump dir (CI upload)",
+    # kernels / JAX (docs/PERF.md)
+    "DG16_NO_JAX_CACHE": "disable the persistent compilation cache",
+    "DG16_JAX_CACHE": "explicit compilation-cache directory",
+    "DG16_FORCE_LIMB_NTT": "route NTTs to the limb-major path anywhere",
+    "DG16_FORCE_TREE_MSM": "route MSMs to the limb tree path anywhere",
+    "DG16_PALLAS_ROLL": "Pallas kernel body mode: fori|scan|unroll",
+    # frontend / store
+    "DG16_NO_CWASM": "force the pure-Python WASM witness VM",
+    "DG16_STORE": "circuit store root directory",
+    # bench / examples / tests
+    "DG16_BENCH_BUDGET_S": "bench.py per-stage time budget",
+    "DG16_BENCH_BATCH_REPS": "bench.py --batch timing repetitions",
+    "DG16_BENCH_BATCH_CHAIN": "bench.py --batch chain-circuit length",
+    "DG16_EXAMPLE_TPU": "examples: allow running on a real TPU",
+    "DG16_VECTORS": "introspect.py: external test-vector directory",
+    "DG16_REQUIRE_VECTORS": "introspect.py: fail when vectors missing",
+    "DG16_TEST_CACHE": "scripts/run_tests.py: keep the jit cache on",
+}
+
+
+def _declared(name: str) -> str:
+    if name not in KNOBS:
+        raise KeyError(
+            f"{name} is not declared in utils.config.KNOBS — add it there "
+            "(and to the docs) before reading it"
+        )
+    return name
+
+
+def env_str(name: str, default: str = "") -> str:
+    v = os.environ.get(_declared(name))
+    return v if v not in (None, "") else default
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """'', unset -> default; '0'/'false' (any case) -> False; else True."""
+    v = os.environ.get(_declared(name))
+    if v is None or v == "":
+        return default
+    return v.lower() not in ("0", "false")
+
+
+def env_int(name: str, default: int) -> int:
+    v = os.environ.get(_declared(name))
+    return int(v) if v not in (None, "") else default
+
+
+def env_float(name: str, default: float) -> float:
+    v = os.environ.get(_declared(name))
+    return float(v) if v not in (None, "") else default
+
 
 @dataclass(frozen=True)
 class NetConfig:
@@ -54,18 +142,16 @@ class NetConfig:
 
     @staticmethod
     def from_env() -> "NetConfig":
-        def f(name: str, default: float) -> float:
-            v = os.environ.get(name)
-            return float(v) if v not in (None, "") else default
-
         return NetConfig(
-            op_timeout_s=f("DG16_NET_OP_TIMEOUT_S", 600.0),
-            connect_timeout_s=f("DG16_NET_CONNECT_TIMEOUT_S", 120.0),
-            connect_base_delay_s=f("DG16_NET_CONNECT_BASE_DELAY_S", 0.1),
-            connect_max_delay_s=f("DG16_NET_CONNECT_MAX_DELAY_S", 5.0),
-            connect_jitter=f("DG16_NET_CONNECT_JITTER", 0.5),
-            heartbeat_interval_s=f("DG16_NET_HEARTBEAT_S", 15.0),
-            idle_timeout_s=f("DG16_NET_IDLE_TIMEOUT_S", 600.0),
+            op_timeout_s=env_float("DG16_NET_OP_TIMEOUT_S", 600.0),
+            connect_timeout_s=env_float("DG16_NET_CONNECT_TIMEOUT_S", 120.0),
+            connect_base_delay_s=env_float(
+                "DG16_NET_CONNECT_BASE_DELAY_S", 0.1
+            ),
+            connect_max_delay_s=env_float("DG16_NET_CONNECT_MAX_DELAY_S", 5.0),
+            connect_jitter=env_float("DG16_NET_CONNECT_JITTER", 0.5),
+            heartbeat_interval_s=env_float("DG16_NET_HEARTBEAT_S", 15.0),
+            idle_timeout_s=env_float("DG16_NET_IDLE_TIMEOUT_S", 600.0),
         )
 
 
@@ -101,21 +187,13 @@ class ServiceConfig:
 
     @staticmethod
     def from_env() -> "ServiceConfig":
-        def i(name: str, default: int) -> int:
-            v = os.environ.get(name)
-            return int(v) if v not in (None, "") else default
-
-        def f(name: str, default: float) -> float:
-            v = os.environ.get(name)
-            return float(v) if v not in (None, "") else default
-
         return ServiceConfig(
-            workers=i("DG16_SERVICE_WORKERS", 2),
-            queue_bound=i("DG16_SERVICE_QUEUE_BOUND", 64),
-            crs_cache_size=i("DG16_SERVICE_CRS_CACHE", 8),
-            round_retries=i("DG16_SERVICE_ROUND_RETRIES", 2),
-            retry_after_s=f("DG16_SERVICE_RETRY_AFTER_S", 5.0),
-            job_history=i("DG16_SERVICE_JOB_HISTORY", 1024),
+            workers=env_int("DG16_SERVICE_WORKERS", 2),
+            queue_bound=env_int("DG16_SERVICE_QUEUE_BOUND", 64),
+            crs_cache_size=env_int("DG16_SERVICE_CRS_CACHE", 8),
+            round_retries=env_int("DG16_SERVICE_ROUND_RETRIES", 2),
+            retry_after_s=env_float("DG16_SERVICE_RETRY_AFTER_S", 5.0),
+            job_history=env_int("DG16_SERVICE_JOB_HISTORY", 1024),
         )
 
 
@@ -145,19 +223,11 @@ class SchedulerConfig:
 
     @staticmethod
     def from_env() -> "SchedulerConfig":
-        def i(name: str, default: int) -> int:
-            v = os.environ.get(name)
-            return int(v) if v not in (None, "") else default
-
-        def f(name: str, default: float) -> float:
-            v = os.environ.get(name)
-            return float(v) if v not in (None, "") else default
-
         return SchedulerConfig(
-            batch_max=i("DG16_BATCH_MAX", 1),
-            batch_linger_ms=f("DG16_BATCH_LINGER_MS", 50.0),
-            max_meshes=i("DG16_SCHED_MESHES", 0),
-            max_inflight=i("DG16_SCHED_INFLIGHT", 0),
+            batch_max=env_int("DG16_BATCH_MAX", 1),
+            batch_linger_ms=env_float("DG16_BATCH_LINGER_MS", 50.0),
+            max_meshes=env_int("DG16_SCHED_MESHES", 0),
+            max_inflight=env_int("DG16_SCHED_INFLIGHT", 0),
         )
 
 

@@ -18,7 +18,6 @@ clock read. See docs/OBSERVABILITY.md.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from contextlib import contextmanager
@@ -26,6 +25,7 @@ from dataclasses import dataclass, field
 
 from ..telemetry import metrics as _metrics
 from ..telemetry import tracing as _tracing
+from . import config as _config
 
 log = logging.getLogger("distributed_groth16_tpu")
 
@@ -37,7 +37,7 @@ _JOB_PHASE_SECONDS = _metrics.registry().histogram(
 
 
 def trace_enabled() -> bool:
-    return os.environ.get("DG16_TRACE", "") not in ("", "0", "false")
+    return _config.env_flag("DG16_TRACE", False)
 
 
 def _emit(msg: str, *args) -> None:
