@@ -20,6 +20,8 @@ Usage:
   python -m distributed_groth16_tpu.api.cli job status --job-id JOB
   python -m distributed_groth16_tpu.api.cli job watch --job-id JOB \
       [--interval 2] [--out proof.bin]
+  python -m distributed_groth16_tpu.api.cli job recover --dry-run \
+      [--journal DIR | --store DIR]
   python -m distributed_groth16_tpu.api.cli trace JOB [--out trace.json]
   python -m distributed_groth16_tpu.api.cli metrics
 
@@ -152,6 +154,46 @@ def cmd_job_watch(args) -> dict:
     return result
 
 
+def cmd_job_recover(args) -> dict:
+    """Inspect a crashed replica's job journal OFFLINE (no server):
+    print exactly what a startup replay would re-enqueue. Read-only by
+    default (`--dry-run` spells that out explicitly); `--compact`
+    additionally rewrites the journal in place (terminal records
+    dropped) — never run THAT against a journal a live service still
+    owns."""
+    from ..service.journal import JobJournal, read_journal
+
+    if args.dry_run and args.compact:
+        raise SystemExit("--dry-run and --compact are mutually exclusive")
+    jdir = args.journal or f"{args.store}/_journal"
+    entries = read_journal(jdir)
+    replayable = [e for e in entries if e.replayable]
+    out = {
+        "journal": jdir,
+        "liveJobs": len(entries),
+        "wouldReplay": [
+            {
+                "jobId": e.id,
+                "kind": e.kind,
+                "circuitId": e.circuit_id,
+                "l": e.l,
+                "state": e.state,
+                "createdAt": e.created_at,
+                "payloadBytes": sum(len(v) for v in e.fields.values()),
+            }
+            for e in replayable
+        ],
+        "quarantined": [e.id for e in entries if e.quarantined],
+        "dryRun": not args.compact,
+    }
+    if args.compact:
+        j = JobJournal(jdir)
+        j.checkpoint()
+        j.close()
+        out["compacted"] = True
+    return out
+
+
 def cmd_trace(args) -> dict:
     """GET /jobs/{id}/trace — fetch a job's Chrome trace-event JSON and
     write it to --out (default trace-<jobId>.json); open the file in
@@ -236,6 +278,24 @@ def main(argv=None) -> None:
     sp.add_argument("--interval", type=float, default=2.0)
     sp.add_argument("--out", default=None, help="write proof bytes here")
     sp.set_defaults(fn=cmd_job_watch)
+
+    sp = jsub.add_parser(
+        "recover",
+        help="offline journal inspection: what would a replay re-enqueue "
+             "(docs/ROBUSTNESS.md); read-only unless --compact",
+    )
+    sp.add_argument("--journal", default=None,
+                    help="journal directory (default <store>/_journal)")
+    sp.add_argument("--store", default="./circuit_store",
+                    help="circuit store root holding the journal")
+    sp.add_argument("--dry-run", action="store_true",
+                    help="read-only inspection (the default; the flag "
+                         "exists to spell the intent out)")
+    sp.add_argument("--compact", action="store_true",
+                    help="ALSO rewrite the journal in place, dropping "
+                         "terminal records — only on a journal no live "
+                         "service owns")
+    sp.set_defaults(fn=cmd_job_recover)
 
     sp = sub.add_parser(
         "trace",

@@ -25,7 +25,11 @@ routes above, funnels through one queue + bounded worker pool):
   GET    /jobs/{id}/result  proof DTO once DONE (409 while in flight)
   DELETE /jobs/{id}       cancel (QUEUED never runs; RUNNING cancels
                           cooperatively at the next phase boundary)
-  GET    /healthz         liveness + pool shape
+  GET    /healthz         liveness + pool shape (always 200 while the
+                          process lives; body flips to "draining")
+  GET    /readyz          readiness: HTTP 503 once a SIGTERM drain began,
+                          so the balancer pulls the replica while
+                          in-flight work finishes
   GET    /stats           queue depth/counters, CRS-cache hit rate,
                           per-phase timing aggregates, batching-scheduler
                           bucket/placement state when DG16_BATCH_MAX > 1
@@ -44,7 +48,9 @@ blobs (frontend/ark_serde.py), JSON-encoded as byte lists.
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
+import signal
 import time
 
 from aiohttp import web
@@ -54,6 +60,7 @@ from ..models.groth16 import verify
 from ..telemetry import metrics as telemetry_metrics
 from ..service import (
     CrsCache,
+    JobJournal,
     JobQueue,
     JobState,
     ProofExecutor,
@@ -64,9 +71,22 @@ from ..service import (
 from ..utils.config import SchedulerConfig, ServiceConfig
 from .store import CircuitStore
 
+log = logging.getLogger(__name__)
+
 MAX_BODY = 100 * 1024 * 1024  # 100 MB limit (main.rs:801)
 
 _JOB_FIELDS = ("witness_file", "input_file")
+
+_DRAINING = telemetry_metrics.registry().gauge(
+    "service_draining",
+    "1 while the service is draining (SIGTERM received: admission closed, "
+    "in-flight work finishing)",
+)
+
+
+class DrainingError(Exception):
+    """Raised at admission once a drain began — mapped to HTTP 503 so a
+    rolling-restart router retries the submission on a healthy replica."""
 
 
 def _error(msg: str, status: int = 500) -> web.Response:
@@ -109,11 +129,28 @@ class ApiServer:
         self.cfg = cfg or ServiceConfig.from_env()
         self.sched_cfg = sched_cfg or SchedulerConfig.from_env()
         self.crs_cache = CrsCache(self.cfg.crs_cache_size)
+        # durable job journal (DG16_JOURNAL, docs/ROBUSTNESS.md): with it
+        # on, every accepted job is fsynced before the 202 and replayed
+        # at the next boot — a crashed replica's successor finishes its
+        # backlog instead of silently dropping it
+        self.journal: JobJournal | None = None
+        jdir = self.cfg.journal_dir
+        if jdir:
+            if jdir.lower() in ("1", "true"):
+                jdir = os.path.join(self.store.root, "_journal")
+            self.journal = JobJournal(
+                jdir,
+                fsync=self.cfg.journal_fsync,
+                segment_records=self.cfg.journal_segment_records,
+            )
+        self.draining = False
+        _DRAINING.set(0)
         self.queue = JobQueue(
             bound=self.cfg.queue_bound,
             workers=self.cfg.workers,
             retry_after_s=self.cfg.retry_after_s,
             history_bound=self.cfg.job_history,
+            journal=self.journal,
         )
         self.executor = ProofExecutor(self.store, self.crs_cache, self.cfg)
         # the batching scheduler (docs/SCHEDULER.md) is opt-in: with
@@ -132,10 +169,14 @@ class ApiServer:
 
     # -- job plumbing --------------------------------------------------------
 
-    def _submit(self, fields: dict[str, bytes], kind: str) -> ProofJob:
+    async def _submit(self, fields: dict[str, bytes], kind: str) -> ProofJob:
         """Build + enqueue a ProofJob from multipart fields. Raises
         KeyError/ValueError on malformed submissions (mapped to 500 by the
-        callers, CustomError-style) and QueueFullError past the bound."""
+        callers, CustomError-style), QueueFullError past the bound, and
+        DrainingError (503) once a graceful drain began. Async because
+        the journal fsync runs off the loop (queue.submit_async)."""
+        if self.draining:
+            raise DrainingError("service is draining; not accepting jobs")
         circuit_id = fields["circuit_id"].decode()
         job = ProofJob(
             kind=kind,
@@ -143,13 +184,69 @@ class ApiServer:
             fields={k: fields[k] for k in _JOB_FIELDS if k in fields},
             l=int(fields.get("l", b"2").decode()),
         )
-        return self.queue.submit(job)
+        return await self.queue.submit_async(job)
+
+    # -- crash recovery + graceful drain -------------------------------------
+
+    def _replay_journal(self) -> int:
+        """Re-enqueue every journaled non-terminal job (startup path):
+        QUEUED jobs simply re-queue; jobs interrupted mid-RUNNING are
+        re-submitted from their journaled payload and prove again.
+        Idempotent by job id — the journal turns the re-submission into a
+        requeue record, not a duplicate payload."""
+        if self.journal is None:
+            return 0
+        replayed = 0
+        for entry in self.journal.pending():
+            interrupted_state = entry.state
+            job = ProofJob(
+                kind=entry.kind,
+                circuit_id=entry.circuit_id,
+                fields=dict(entry.fields),
+                l=entry.l,
+                id=entry.id,
+                created_at=entry.created_at,
+            )
+            try:
+                self.queue.submit(job)
+            except QueueFullError:
+                # a replica restarted under a full backlog: the rest of
+                # the journal stays live and the NEXT boot (or a manual
+                # `dg16-cli job recover`) picks it up
+                log.warning("journal replay stopped at the admission bound")
+                break
+            self.journal.note_replayed(interrupted_state)
+            replayed += 1
+        if replayed:
+            log.info("journal replay re-enqueued %d job(s)", replayed)
+        return replayed
+
+    def begin_drain(self) -> None:
+        """Flip the service into draining: /healthz turns 503, admission
+        refuses (503 + DrainingError), lingering buckets flush early."""
+        self.draining = True
+        _DRAINING.set(1)
+
+    async def drain(self) -> None:
+        """Graceful drain (SIGTERM): stop admitting, flush partial
+        batches, then wait until every accepted job is terminal — so a
+        rolling restart loses nothing even before the journal replays."""
+        self.begin_drain()
+        while True:
+            if self.scheduler is not None:
+                await self.scheduler.drain()
+            # every registered job terminal — not just "queue empty":
+            # a job mid-offer (between queue pop and bucket admission)
+            # is in neither gauge but is still owed work
+            if all(j.state.terminal for j in self.queue.jobs.values()):
+                return
+            await asyncio.sleep(0.05)
 
     async def _submit_and_await(self, request, kind: str) -> ProofJob:
         """The legacy synchronous routes: enqueue, then block the request
         (not the loop) until the job is terminal."""
         fields = await _read_multipart(request)
-        job = self._submit(fields, kind)
+        job = await self._submit(fields, kind)
         await job.wait()
         return job
 
@@ -181,10 +278,12 @@ class ApiServer:
             job = await self._submit_and_await(request, "prove")
         except QueueFullError as e:
             return _busy(e)
+        except DrainingError as e:
+            return _error(str(e), status=503)
         except Exception as e:  # noqa: BLE001
             return _error(str(e))
         if job.state is not JobState.DONE:
-            return _error((job.error or {}).get("error", job.state.value))
+            return _error((job.error or {}).get("message", job.state.value))
         return web.json_response(
             {
                 "circuitId": job.circuit_id,
@@ -199,10 +298,12 @@ class ApiServer:
             job = await self._submit_and_await(request, "mpc_prove")
         except QueueFullError as e:
             return _busy(e)
+        except DrainingError as e:
+            return _error(str(e), status=503)
         except Exception as e:  # noqa: BLE001
             return _error(str(e))
         if job.state is not JobState.DONE:
-            return _error((job.error or {}).get("error", job.state.value))
+            return _error((job.error or {}).get("message", job.state.value))
         return web.json_response(
             {
                 "circuitId": job.circuit_id,
@@ -258,9 +359,11 @@ class ApiServer:
         try:
             fields = await _read_multipart(request)
             mpc = fields.get("mpc", b"").decode().lower() in ("1", "true", "yes")
-            job = self._submit(fields, "mpc_prove" if mpc else "prove")
+            job = await self._submit(fields, "mpc_prove" if mpc else "prove")
         except QueueFullError as e:
             return _busy(e)
+        except DrainingError as e:
+            return _error(str(e), status=503)
         except Exception as e:  # noqa: BLE001
             return _error(str(e))
         return web.json_response(
@@ -302,7 +405,7 @@ class ApiServer:
         if isinstance(job, web.Response):
             return job
         if job.state is JobState.FAILED:
-            return _error((job.error or {}).get("error", "job failed"))
+            return _error((job.error or {}).get("message", "job failed"))
         if job.state is JobState.CANCELLED:
             return _error("job was cancelled", status=410)
         if job.state is not JobState.DONE:
@@ -332,21 +435,37 @@ class ApiServer:
         )
 
     async def healthz(self, request):
+        """LIVENESS: always 200 while the process is healthy — including
+        during a drain (the body says "draining"). A liveness probe must
+        not kill a replica that is deliberately finishing its work; use
+        /readyz for rotation decisions."""
         s = self.queue.stats()
         return web.json_response(
             {
-                "status": "ok",
+                "status": "draining" if self.draining else "ok",
                 "workers": s["workers"],
                 "queueDepth": s["queueDepth"],
                 "running": s["running"],
             }
         )
 
+    async def readyz(self, request):
+        """READINESS: 503 while draining so the load balancer pulls the
+        replica out of rotation while in-flight proofs finish
+        (docs/ROBUSTNESS.md "Graceful drain")."""
+        body = {"status": "draining" if self.draining else "ok"}
+        return web.json_response(body, status=503 if self.draining else 200)
+
     async def stats(self, request):
         return web.json_response(
             {
                 "queue": self.queue.stats(),
                 "crsCache": self.crs_cache.stats(),
+                "journal": (
+                    self.journal.stats()
+                    if self.journal is not None
+                    else {"enabled": False}
+                ),
                 "scheduler": (
                     self.scheduler.stats()
                     if self.scheduler is not None
@@ -366,10 +485,65 @@ class ApiServer:
     # -- app -----------------------------------------------------------------
 
     async def _on_startup(self, app):
+        # replay BEFORE the workers start pulling: the backlog of a
+        # crashed predecessor re-queues in submission order, ahead of
+        # anything the fresh process admits
+        self._replay_journal()
         await self.pool.start()
+        self._install_signal_handlers()
 
     async def _on_cleanup(self, app):
         await self.pool.stop()
+        self._remove_signal_handlers()
+        if self.journal is not None:
+            # clean-shutdown checkpoint: compact to exactly the jobs
+            # still owed work (empty after a full drain) so the next
+            # boot replays precisely those
+            self.journal.checkpoint()
+            self.journal.close()
+
+    # -- SIGTERM -> drain -> exit ---------------------------------------------
+
+    def _install_signal_handlers(self) -> None:
+        """SIGTERM starts a graceful drain instead of aiohttp's immediate
+        teardown: healthz flips to draining, in-flight jobs finish, and
+        only then does the app exit (cleanup checkpoints the journal).
+        No-op where loop signal handlers are unsupported."""
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGTERM, self._on_sigterm)
+            self._sigterm_installed = True
+        except (NotImplementedError, RuntimeError, ValueError):
+            self._sigterm_installed = False
+
+    def _remove_signal_handlers(self) -> None:
+        if getattr(self, "_sigterm_installed", False):
+            try:
+                asyncio.get_running_loop().remove_signal_handler(
+                    signal.SIGTERM
+                )
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass
+            self._sigterm_installed = False
+
+    def _on_sigterm(self) -> None:
+        log.info("SIGTERM: draining before shutdown")
+        # keep a strong reference: the loop holds tasks weakly, and a
+        # GC during a multi-minute drain would silently abort it —
+        # leaving a 503 replica that never exits
+        self._drain_task = asyncio.ensure_future(self._drain_then_exit())
+
+    async def _drain_then_exit(self) -> None:
+        await self.drain()
+        # mirror aiohttp's own signal path: GracefulExit is a SystemExit
+        # subclass, so raising it from a call_soon callback escapes
+        # run_forever and run_app proceeds to cleanup
+        loop = asyncio.get_running_loop()
+        loop.call_soon(self._raise_graceful_exit)
+
+    @staticmethod
+    def _raise_graceful_exit() -> None:
+        raise web.GracefulExit()
 
     def app(self) -> web.Application:
         app = web.Application(client_max_size=MAX_BODY)
@@ -392,6 +566,7 @@ class ApiServer:
         app.router.add_get("/jobs/{job_id}/result", self.job_result)
         app.router.add_delete("/jobs/{job_id}", self.job_cancel)
         app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/readyz", self.readyz)
         app.router.add_get("/stats", self.stats)
         app.router.add_get("/metrics", self.metrics)
         return app

@@ -31,8 +31,15 @@ import logging
 import time
 
 from ..service.jobs import JobCancelled, JobState
+from ..telemetry import flight as _flight
+from ..telemetry import metrics as _tm
 from ..utils.config import SchedulerConfig
-from .batch_prover import BatchProver, ProverCache, prove_batch  # noqa: F401
+from .batch_prover import (  # noqa: F401
+    BatchFault,
+    BatchProver,
+    ProverCache,
+    prove_batch,
+)
 from .bucketer import Batch, Bucketer, BucketKey  # noqa: F401
 from .placement import DevicePool, MeshLease  # noqa: F401
 
@@ -40,17 +47,54 @@ log = logging.getLogger(__name__)
 
 __all__ = [
     "Batch",
+    "BatchFault",
     "BatchProver",
     "BatchScheduler",
     "Bucketer",
     "BucketKey",
     "DevicePool",
     "MeshLease",
+    "PoisonedJobError",
     "ProverCache",
     "prove_batch",
 ]
 
+_REG = _tm.registry()
+_POISONED = _REG.counter(
+    "scheduler_batch_poisoned_total",
+    "Jobs quarantined after repeatedly failing their batch alone",
+    ("bucket",),
+)
+_BISECTIONS = _REG.counter(
+    "scheduler_batch_bisections_total",
+    "Batch splits performed while isolating a poisoned job",
+)
+# the batch_prover outcome counter (get-or-create is idempotent): the
+# bisection verdicts — quarantined poison, slice-suspect failures — are
+# finalized HERE, so they're counted here; witness-phase and done
+# outcomes are counted where they land, in batch_prover.run_batch
+_BATCH_JOBS = _REG.counter(
+    "scheduler_batch_jobs_total",
+    "Jobs that completed through the batched proving path, by outcome",
+    ("outcome",),
+)
+
 _BATCHABLE_KINDS = ("prove", "mpc_prove")
+
+
+class PoisonedJobError(Exception):
+    """Terminal verdict for a job that killed its batch alone N times
+    (DG16_SCHED_POISON_RETRIES): quarantined so it can never take down
+    another batch — or be resurrected by a journal replay."""
+
+    def __init__(self, job_id: str, attempts: int, cause: BaseException):
+        self.job_id = job_id
+        self.attempts = attempts
+        self.cause = cause
+        super().__init__(
+            f"job {job_id} quarantined: poisoned its batch in {attempts} "
+            f"solo attempts (last: {type(cause).__name__})"
+        )
 
 
 class BatchScheduler:
@@ -70,9 +114,17 @@ class BatchScheduler:
         self.bucketer = Bucketer(
             self.cfg.batch_max, self.cfg.batch_linger_ms / 1000.0
         )
-        self.devices = DevicePool(devices, self.cfg.max_meshes)
+        self.devices = DevicePool(
+            devices,
+            self.cfg.max_meshes,
+            breaker_threshold=self.cfg.breaker_threshold,
+            breaker_cooldown_s=self.cfg.breaker_cooldown_s,
+        )
         self.batch_prover = BatchProver(executor)
         self._meta: dict[str, tuple[int, int]] = {}  # cid -> (m, num_inputs)
+        # solo-failure tally feeding the poisoned-job quarantine
+        self._solo_failures: dict[str, int] = {}
+        self.jobs_poisoned = 0
         self._inflight = asyncio.Semaphore(
             self.cfg.max_inflight or 4 * self.cfg.batch_max
         )
@@ -96,18 +148,37 @@ class BatchScheduler:
             await asyncio.gather(self._runner, return_exceptions=True)
             self._runner = None
         # jobs still lingering never got a batch — terminal-fail them like
-        # the pool fails undrained QUEUED jobs, so nothing waits forever
+        # the pool fails undrained QUEUED jobs, so nothing waits forever.
+        # fail_terminal journals BEFORE the in-memory transition so a
+        # crash mid-shutdown can't resurrect deliberately failed jobs.
         for batch in self.bucketer.flush():
             for job in batch.jobs:
                 if job.state is JobState.QUEUED:
-                    job.mark_failed(RuntimeError("service shutting down"))
-                    self.queue.on_finished(job)
+                    self.queue.fail_terminal(
+                        job, RuntimeError("service shutting down")
+                    )
                 self._inflight.release()
         # in-flight batches hold real proving threads — let them finish
         # (a proof that completes during shutdown is a result, not a
         # failure; same contract as WorkerPool.stop)
         if self._batch_tasks:
             await asyncio.gather(*self._batch_tasks, return_exceptions=True)
+
+    async def drain(self) -> None:
+        """Graceful-drain hook (SIGTERM, docs/ROBUSTNESS.md): release
+        every lingering bucket NOW — a partial batch at drain time proves
+        immediately instead of waiting out its linger — and wait for all
+        in-flight batches to finish. Unlike stop(), nothing is failed and
+        the linger loop keeps running for any still-arriving jobs."""
+        for batch in self.bucketer.flush():
+            self._spawn(batch)
+        while self._batch_tasks:
+            await asyncio.gather(*list(self._batch_tasks),
+                                 return_exceptions=True)
+
+    @property
+    def idle(self) -> bool:
+        return len(self.bucketer) == 0 and not self._batch_tasks
 
     # -- admission (worker side) ---------------------------------------------
 
@@ -156,8 +227,9 @@ class BatchScheduler:
                 self._wake.set()
         except asyncio.CancelledError:
             if job.state is JobState.QUEUED:
-                job.mark_failed(RuntimeError("service shutting down"))
-                self.queue.on_finished(job)
+                self.queue.fail_terminal(
+                    job, RuntimeError("service shutting down")
+                )
             raise
         finally:
             if held:
@@ -227,19 +299,27 @@ class BatchScheduler:
         if not jobs:
             lease.release()
             return
+        cancelled = False
         try:
             for job in jobs:
                 job.mark_running()
                 self.queue.on_started(job)
-            try:
-                outcomes = await asyncio.to_thread(
-                    self.batch_prover.run_batch, jobs, batch.key, lease.mesh
-                )
-            except BaseException as e:  # noqa: BLE001 — never lose a job
-                outcomes = [(job, e) for job in jobs]
+            outcomes = await self._prove_bisecting(
+                jobs, batch.key, lease, lease.mesh
+            )
+        except asyncio.CancelledError:
+            # loop teardown mid-batch: never lose a job — record a
+            # terminal outcome for each, then honor the cancellation
+            # after the bookkeeping below
+            cancelled = True
+            outcomes = [
+                (job, RuntimeError("batch cancelled at shutdown"))
+                for job in jobs
+            ]
         finally:
             lease.release()
         for job, out in outcomes:
+            self._solo_failures.pop(job.id, None)  # terminal either way
             if isinstance(out, JobCancelled):
                 job.mark_cancelled()
             elif isinstance(out, BaseException):
@@ -251,6 +331,116 @@ class BatchScheduler:
             self._inflight.release()
         self.batches_dispatched += 1
         self.jobs_batched += len(jobs)
+        if cancelled:
+            raise asyncio.CancelledError
+
+    # -- poisoned-batch bisection --------------------------------------------
+
+    async def _prove_bisecting(self, jobs, key, lease, mesh) -> list:
+        """Run a batch; on a BATCH-WIDE fault, isolate the culprit by
+        bisection instead of failing every batchmate: retry the faulted
+        jobs in halves, and a job that still kills its batch ALONE after
+        DG16_SCHED_POISON_RETRIES solo attempts is quarantined
+        (PoisonedJobError + journal mark + flight-recorder dump) while
+        everyone else completes. Every EXECUTION ATTEMPT also feeds the
+        slice's circuit breaker — a mesh-level fault counts one failure,
+        a successful program resets it — so a genuinely sick slice trips
+        even while bisection is still assigning blame, and a healthy
+        slice that proved the batchmates ends the lease closed.
+        Termination: halving shrinks multi-job faults to singletons, and
+        the per-job solo counter caps singleton retries. Returns final
+        [(job, outcome)] pairs."""
+        # lease-scoped evidence: did ANY mesh execution succeed on this
+        # slice during this batch? The quarantine verdict requires it —
+        # without a working-slice proof, a dead device would brand every
+        # innocent batchmate as poison. Verdicts are DEFERRED until all
+        # halves ran: a poisoned job sorted before its successful
+        # batchmates must not escape just because the evidence arrived
+        # after its retries were exhausted.
+        ctx = {"succeeded": False, "exhausted": []}
+        final = await self._bisect(jobs, key, lease, mesh, ctx)
+        for job, cause, attempts in ctx["exhausted"]:
+            if ctx["succeeded"]:
+                final.append((job, self._quarantine(job, key, cause,
+                                                    attempts)))
+            else:
+                # nothing succeeded on this slice the whole batch: the
+                # slice is as suspect as the job, so fail WITHOUT the
+                # quarantine brand — the breaker is already counting
+                # these faults, and a resubmission may land on a
+                # healthy slice
+                _BATCH_JOBS.labels(outcome="failed").inc()
+                final.append((job, cause))
+        return final
+
+    async def _bisect(self, jobs, key, lease, mesh, ctx: dict) -> list:
+        try:
+            raw = await asyncio.to_thread(
+                self.batch_prover.run_batch, jobs, key, mesh
+            )
+        except asyncio.CancelledError:
+            # task teardown, not a device fault: it must neither feed the
+            # breaker nor enter the retry ladder — _run_batch terminal-
+            # fails the jobs and re-raises
+            raise
+        except BaseException as e:  # noqa: BLE001 — never lose a job
+            fault = e if isinstance(e, BatchFault) else BatchFault(e)
+            raw = [(job, fault) for job in jobs]
+        final, faulted = [], []
+        for job, out in raw:
+            if isinstance(out, BatchFault):
+                faulted.append((job, out))
+            else:
+                final.append((job, out))
+        if faulted:
+            self.devices.report(lease, ok=False)
+        elif any(not isinstance(o, BaseException) for _, o in final):
+            # host-side-only outcomes (bad witness, cancel) say nothing
+            # about the devices — only a real proof counts as success
+            ctx["succeeded"] = True
+            self.devices.report(lease, ok=True)
+        if not faulted:
+            return final
+        if len(faulted) > 1:
+            _BISECTIONS.inc()
+            mid = len(faulted) // 2
+            final += await self._bisect(
+                [j for j, _ in faulted[:mid]], key, lease, mesh, ctx
+            )
+            final += await self._bisect(
+                [j for j, _ in faulted[mid:]], key, lease, mesh, ctx
+            )
+            return final
+        # one job failed alone: it is the prime suspect — retry it solo
+        # until the retry budget is spent, then hand the verdict to the
+        # deferred pass in _prove_bisecting
+        job, fault = faulted[0]
+        cause = fault.cause
+        attempts = self._solo_failures.get(job.id, 0) + 1
+        self._solo_failures[job.id] = attempts
+        if attempts < max(1, self.cfg.poison_retries):
+            final += await self._bisect([job], key, lease, mesh, ctx)
+            return final
+        ctx["exhausted"].append((job, cause, attempts))
+        return final
+
+    def _quarantine(self, job, key, cause, attempts) -> PoisonedJobError:
+        self._solo_failures.pop(job.id, None)
+        self.jobs_poisoned += 1
+        verdict = PoisonedJobError(job.id, attempts, cause)
+        _POISONED.labels(bucket=key.label).inc()
+        _BATCH_JOBS.labels(outcome="poisoned").inc()
+        if self.queue.journal is not None:
+            # quarantine mark BEFORE the terminal transition: a crash in
+            # between must not let a replay re-enqueue the poison
+            self.queue.journal.append_quarantine(job.id, str(verdict))
+        log.error("quarantining poisoned job %s: %s", job.id, verdict)
+        _flight.dump_soon(
+            "batch_poisoned",
+            extra={"jobId": job.id, "bucket": key.label,
+                   "attempts": attempts, "cause": type(cause).__name__},
+        )
+        return verdict
 
     # -- /stats --------------------------------------------------------------
 
@@ -261,6 +451,7 @@ class BatchScheduler:
             "lingerMs": self.cfg.batch_linger_ms,
             "batchesDispatched": self.batches_dispatched,
             "jobsBatched": self.jobs_batched,
+            "jobsPoisoned": self.jobs_poisoned,
             "bucketOccupancy": self.bucketer.occupancy(),
             "placement": self.devices.stats(),
             "proverCache": {

@@ -57,6 +57,18 @@ def _next_pow2(x: int) -> int:
     return 1 << max(0, x - 1).bit_length()
 
 
+class BatchFault(Exception):
+    """A BATCH-WIDE execution failure (the mesh program itself died), as
+    opposed to a per-job outcome (bad witness, cancel). The scheduler
+    treats these specially: the batchmates are innocent until proven
+    otherwise, so it bisects — retry halves, then solo — instead of
+    failing everyone (docs/SCHEDULER.md "Poisoned batches")."""
+
+    def __init__(self, cause: BaseException):
+        self.cause = cause
+        super().__init__(f"batch execution failed: {cause}")
+
+
 class ProverCache:
     """Small LRU of jitted batch provers keyed by (circuit, l, m, padded
     batch size, device slice) — the 'jit caches hit once per bucket'
@@ -172,6 +184,7 @@ class BatchProver:
             for job in jobs:
                 try:
                     job.check_cancel()
+                    job.note_phase("witness")
                     t_w = time.monotonic()
                     z = self.executor.resolve_witness(job, r1cs)
                     job.timings.record("witness", time.monotonic() - t_w)
@@ -196,6 +209,8 @@ class BatchProver:
                     tuple(id(d) for d in mesh.devices.flat),
                 )
                 t0 = time.monotonic()
+                for job in good:
+                    job.note_phase("batch_prove")
                 try:
                     prover = self.provers.get_or_build(
                         cache_key,
@@ -208,9 +223,12 @@ class BatchProver:
                         prover=prover,
                     )
                 except BaseException as e:  # noqa: BLE001 — batch-wide fault
+                    # NOT counted as failed here: the scheduler bisects
+                    # BatchFault outcomes, and the batchmates usually
+                    # complete on retry — only the final verdict counts
+                    fault = BatchFault(e)
                     for job in good:
-                        outcomes.append((job, e))
-                        _BATCH_JOBS.labels(outcome="failed").inc()
+                        outcomes.append((job, fault))
                     return outcomes
                 prove_s = time.monotonic() - t0
                 share = 1.0 / len(good)

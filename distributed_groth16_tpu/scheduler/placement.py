@@ -13,12 +13,25 @@ itself runs on a thread): an `asyncio.Condition` parks waiters when every
 slice is busy, and `release()` wakes exactly them. The Mesh object is
 built lazily per lease slice and memoized, so lease accounting is testable
 with fake device objects and repeated leases don't rebuild meshes.
+
+Circuit breakers (docs/ROBUSTNESS.md): each (slot, n_parties) slice
+carries a consecutive-failure counter fed by the scheduler's per-batch
+outcome reports. `threshold` consecutive failures TRIP the slice — it
+enters an OPEN cooldown and `_free_slot` routes new batches around it;
+after `cooldown_s` it goes HALF-OPEN and admits exactly one probe batch,
+whose outcome either closes the breaker or re-opens the cooldown. The
+`mesh_breaker_state{slice}` gauge spells the state machine for
+dashboards (0 closed / 1 half-open / 2 open). A sick TPU slice therefore
+costs its own batches only until the breaker trips, not every batch the
+placement round-robin would have handed it.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 
+from ..telemetry import flight as _flight
 from ..telemetry import metrics as _tm
 
 _REG = _tm.registry()
@@ -37,6 +50,77 @@ _MESH_WAIT = _REG.histogram(
     "scheduler_mesh_wait_seconds",
     "Seconds a released batch waited for a free mesh slice",
 )
+_BREAKER_STATE = _REG.gauge(
+    "mesh_breaker_state",
+    "Circuit-breaker state per device slice: 0 closed, 1 half-open, "
+    "2 open (cooling down)",
+    ("slice",),
+)
+_BREAKER_TRIPS = _REG.counter(
+    "mesh_breaker_trips_total",
+    "Breaker trips (closed/half-open -> open) per device slice",
+    ("slice",),
+)
+
+# breaker states — gauge values are part of the dashboard contract
+_CLOSED, _HALF_OPEN, _OPEN = 0, 1, 2
+
+
+class _Breaker:
+    """Consecutive-failure circuit breaker for one (slot, n_parties)
+    device slice. Pure state machine — the pool drives it under its own
+    event-loop-side accounting, so no lock is needed."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self.state = _CLOSED
+        self.failures = 0
+        self.opened_at = 0.0
+        self.probing = False  # half-open: exactly one probe batch at a time
+
+    def allows(self, now: float, cooldown_s: float) -> bool:
+        if self.state == _CLOSED:
+            return True
+        if self.state == _OPEN:
+            if now - self.opened_at >= cooldown_s:
+                self.state = _HALF_OPEN
+                self.probing = False
+                _BREAKER_STATE.labels(slice=self.label).set(_HALF_OPEN)
+                return True
+            return False
+        return not self.probing  # half-open: one probe in flight max
+
+    def on_lease(self) -> None:
+        if self.state == _HALF_OPEN:
+            self.probing = True
+
+    def record_success(self) -> None:
+        self.state = _CLOSED
+        self.failures = 0
+        self.probing = False
+        _BREAKER_STATE.labels(slice=self.label).set(_CLOSED)
+
+    def record_failure(self, now: float, threshold: int) -> bool:
+        """Returns True when this failure TRIPS the breaker (closed ->
+        open or a failed half-open probe re-opening)."""
+        self.probing = False
+        if self.state == _HALF_OPEN:
+            self.state = _OPEN
+            self.opened_at = now
+            _BREAKER_STATE.labels(slice=self.label).set(_OPEN)
+            return True
+        self.failures += 1
+        if self.state == _CLOSED and self.failures >= threshold:
+            self.state = _OPEN
+            self.opened_at = now
+            _BREAKER_STATE.labels(slice=self.label).set(_OPEN)
+            return True
+        return False
+
+    def cooldown_remaining(self, now: float, cooldown_s: float) -> float | None:
+        if self.state != _OPEN:
+            return None
+        return max(0.0, cooldown_s - (now - self.opened_at))
 
 
 class MeshLease:
@@ -63,13 +147,27 @@ class MeshLease:
 
 
 class DevicePool:
-    def __init__(self, devices=None, max_meshes: int = 0):
+    def __init__(
+        self,
+        devices=None,
+        max_meshes: int = 0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 30.0,
+        clock=time.monotonic,
+    ):
         if devices is None:
             import jax
 
             devices = jax.devices()
         self.devices = list(devices)
         self.max_meshes = max_meshes  # 0 = as many as the inventory allows
+        # circuit-breaker knobs (DG16_BREAKER_*): <=0 threshold disables
+        # breakers entirely; clock is injectable so cooldown/half-open
+        # transitions are unit-testable without sleeping
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self._clock = clock
+        self._breakers: dict[tuple[int, int], _Breaker] = {}
         # busy DEVICE indices (not slot numbers): mixed party counts lease
         # concurrently, and a slot number means a different device range
         # per n_parties — only the device set itself is collision-safe
@@ -87,19 +185,66 @@ class DevicePool:
             cap = min(cap, self.max_meshes)
         return cap
 
+    # -- circuit breakers ----------------------------------------------------
+
+    def _breaker(self, slot: int, n_parties: int) -> _Breaker:
+        key = (slot, n_parties)
+        br = self._breakers.get(key)
+        if br is None:
+            br = self._breakers[key] = _Breaker(f"{n_parties}p{slot}")
+        return br
+
+    def report(self, lease: "MeshLease", ok: bool) -> None:
+        """Scheduler-side outcome report for one finished batch: success
+        closes the slice's breaker, a mesh-level failure advances it
+        toward (or past) the trip threshold. No-op with breakers off."""
+        if self.breaker_threshold <= 0:
+            return
+        br = self._breaker(lease.slot, len(lease.devices))
+        if ok:
+            br.record_success()
+            return
+        if br.record_failure(self._clock(), self.breaker_threshold):
+            _BREAKER_TRIPS.labels(slice=br.label).inc()
+            _flight.note("breaker_trip", slice=br.label)
+
+    def _allows(self, slot: int, n_parties: int) -> bool:
+        if self.breaker_threshold <= 0:
+            return True
+        br = self._breakers.get((slot, n_parties))
+        return br is None or br.allows(self._clock(), self.breaker_cooldown_s)
+
+    def _next_breaker_expiry(self, n_parties: int) -> float | None:
+        """Seconds until the earliest OPEN breaker of this party count
+        could go half-open — the bounded wait an acquire() uses when
+        every otherwise-free slice is tripped (nothing will notify the
+        condition when a cooldown lapses)."""
+        now = self._clock()
+        remains = [
+            r
+            for (slot, n), br in self._breakers.items()
+            if n == n_parties
+            and (r := br.cooldown_remaining(now, self.breaker_cooldown_s))
+            is not None
+        ]
+        return min(remains) + 0.001 if remains else None
+
     def _free_slot(self, n_parties: int) -> int | None:
         if self.max_meshes > 0 and self._leases >= self.max_meshes:
             return None
         for slot in range(len(self.devices) // n_parties):
             lo, hi = slot * n_parties, (slot + 1) * n_parties
-            if all(i not in self._busy for i in range(lo, hi)):
+            if all(i not in self._busy for i in range(lo, hi)) and (
+                self._allows(slot, n_parties)
+            ):
                 return slot
         return None
 
     async def acquire(self, n_parties: int) -> MeshLease:
         """Lease a free slice of n_parties devices, waiting if every slice
-        is busy. Raises RuntimeError when the inventory can NEVER satisfy
-        the request (callers gate on capacity() at admission)."""
+        is busy or breaker-tripped. Raises RuntimeError when the inventory
+        can NEVER satisfy the request (callers gate on capacity() at
+        admission)."""
         if self.capacity(n_parties) < 1:
             raise RuntimeError(
                 f"no mesh slice of {n_parties} devices available "
@@ -114,15 +259,31 @@ class DevicePool:
                     lo, hi = slot * n_parties, (slot + 1) * n_parties
                     self._busy.update(range(lo, hi))
                     self._leases += 1
+                    br = self._breakers.get((slot, n_parties))
+                    if br is not None:
+                        br.on_lease()  # a half-open slice admits one probe
                     self._update_gauges(n_parties)
                     _MESH_WAIT.observe(loop.time() - t0)
                     return MeshLease(self, slot, self.devices[lo:hi])
-                await self._cond.wait()
+                # bounded wait: a release() notifies, and an OPEN breaker
+                # lapsing into half-open must wake us even if nobody does
+                timeout = self._next_breaker_expiry(n_parties)
+                try:
+                    await asyncio.wait_for(self._cond.wait(), timeout)
+                except asyncio.TimeoutError:
+                    pass
 
     def _release(self, lease: "MeshLease") -> None:
         lo = lease.slot * len(lease.devices)
         self._busy.difference_update(range(lo, lo + len(lease.devices)))
         self._leases -= 1
+        br = self._breakers.get((lease.slot, len(lease.devices)))
+        if br is not None and br.probing:
+            # the probe lease ended without a report (every job cancelled
+            # or failed host-side — nothing mesh-level happened): the
+            # probe was INCONCLUSIVE, so let the next batch probe again
+            # rather than blacking the slice out forever
+            br.probing = False
         _MESH_IN_USE.set(self._leases)
         if self.devices:
             _MESH_UTIL.set(len(self._busy) / len(self.devices))
@@ -154,9 +315,16 @@ class DevicePool:
         return mesh
 
     def stats(self) -> dict:
+        state_names = {_CLOSED: "closed", _HALF_OPEN: "half-open",
+                       _OPEN: "open"}
         return {
             "devices": len(self.devices),
             "busyDevices": len(self._busy),
             "leasesInUse": self._leases,
             "maxMeshes": self.max_meshes,
+            "breakers": {
+                br.label: state_names[br.state]
+                for br in self._breakers.values()
+                if br.state != _CLOSED or br.failures > 0
+            },
         }

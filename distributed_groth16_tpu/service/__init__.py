@@ -7,17 +7,22 @@ request path through one `ProofExecutor` proving funnel, and the
 """
 
 from .crs_cache import CrsCache
-from .jobs import JobCancelled, JobState, ProofJob
+from .jobs import JobCancelled, JobState, ProofJob, error_dto
+from .journal import JobJournal, JournalEntry, read_journal
 from .queue import JobQueue, QueueFullError
 from .worker import ProofExecutor, WorkerPool
 
 __all__ = [
     "CrsCache",
     "JobCancelled",
+    "JobJournal",
     "JobQueue",
     "JobState",
+    "JournalEntry",
     "ProofExecutor",
     "ProofJob",
     "QueueFullError",
     "WorkerPool",
+    "error_dto",
+    "read_journal",
 ]

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import enum
 import json
+import re
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -29,6 +30,35 @@ from typing import Any
 from ..telemetry import aggregate as _aggregate
 from ..telemetry.tracing import TraceBuffer, chrome_envelope
 from ..utils.timers import PhaseTimings
+
+# Failure-DTO sanitization (docs/ROBUSTNESS.md): exception messages are
+# operator-facing via GET /jobs/{id} AND durable via the job journal, so
+# they must not leak witness-adjacent material. Two redactions cover the
+# real leak vectors observed in practice: filesystem paths (a failed
+# witness upload names the tmp file it was spooled to) and huge integer
+# literals (a field-element mismatch embeds the ~77-digit value).
+_PATH_RE = re.compile(r"(?:/[\w.+-]+){2,}/?")
+_BIGINT_RE = re.compile(r"\d{20,}")
+_MESSAGE_CAP = 300
+
+
+def sanitize_message(msg: str) -> str:
+    msg = _PATH_RE.sub("<path>", msg)
+    msg = _BIGINT_RE.sub("<bigint>", msg)
+    if len(msg) > _MESSAGE_CAP:
+        msg = msg[:_MESSAGE_CAP] + "…"
+    return msg
+
+
+def error_dto(exc: BaseException, phase: str | None = None) -> dict[str, Any]:
+    """The structured failure shape every surface shares — status DTO,
+    journal record, shutdown pre-journal: {type, message, phase}, never
+    a raw repr(exc)."""
+    return {
+        "type": type(exc).__name__,
+        "message": sanitize_message(str(exc)),
+        "phase": phase,
+    }
 
 
 class JobState(str, enum.Enum):
@@ -91,6 +121,11 @@ class ProofJob:
         self._chrome_json: str | None = None
         self._critical_path: dict | None = None
         self._dropped_spans = 0
+        # the phase the executor is currently in (note_phase) — failure
+        # DTOs carry it so "FAILED" says where; written from the worker
+        # thread, read at the loop-side terminal transition (a str swap
+        # is atomic, no lock needed)
+        self._phase: str | None = None
 
     # -- executor-side hooks (worker thread) --------------------------------
 
@@ -99,6 +134,11 @@ class ProofJob:
         phases so a cancel costs at most one phase, not the whole proof."""
         if self._cancel_flag.is_set():
             raise JobCancelled(self.id)
+
+    def note_phase(self, name: str | None) -> None:
+        """Executors stamp the phase they are entering so a failure DTO
+        can say WHERE the job died ({type, message, phase})."""
+        self._phase = name
 
     # -- loop-side transitions ----------------------------------------------
 
@@ -113,7 +153,7 @@ class ProofJob:
 
     def mark_failed(self, exc: BaseException) -> None:
         self.state = JobState.FAILED
-        self.error = {"error": str(exc), "type": type(exc).__name__}
+        self.error = error_dto(exc, phase=self._phase)
         self._finish()
 
     def mark_cancelled(self) -> None:

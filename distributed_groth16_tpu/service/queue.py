@@ -25,7 +25,7 @@ from collections import deque
 
 from ..telemetry import metrics as _tm
 from ..utils.timers import PhaseTimings
-from .jobs import JobState, ProofJob
+from .jobs import JobState, ProofJob, error_dto
 
 # Queue-shape metrics (docs/OBSERVABILITY.md). Process-wide like the rest
 # of the registry: a process runs one service, so queue gauges are global.
@@ -75,10 +75,15 @@ class JobQueue:
         workers: int = 2,
         retry_after_s: float = 5.0,
         history_bound: int = 1024,
+        journal=None,
     ):
         self.bound = bound
         self.workers = max(1, workers)
         self.default_retry_after_s = retry_after_s
+        # optional durable job journal (service/journal.py): every
+        # admission and state transition flowing through the queue is
+        # recorded, so a crashed replica's successor can replay
+        self.journal = journal
         # terminal jobs stay addressable for status polling, but only the
         # `history_bound` most recent — without eviction the registry (and
         # every result payload) grows without bound on a long-lived service
@@ -103,6 +108,54 @@ class JobQueue:
     # -- submission (request path) ------------------------------------------
 
     def submit(self, job: ProofJob) -> ProofJob:
+        """Synchronous admission (tests, startup replay — no traffic to
+        stall). The request path uses submit_async so the journal fsync
+        happens off the event loop."""
+        self._admit_or_reject(job)
+        if self.journal is not None:
+            # durability BEFORE admission: once the caller sees a 202 the
+            # job survives a crash (WAL contract, service/journal.py)
+            self.journal.append_submit(job)
+        self._enqueue(job)
+        return job
+
+    async def submit_async(self, job: ProofJob) -> ProofJob:
+        """Request-path admission: the journal append (base64 of a
+        payload up to the 100 MB body cap + write + fsync) must not run
+        on the event loop — one big upload would stall /healthz,
+        heartbeats, and every concurrent request. The admission slot is
+        reserved BEFORE the thread hop so the 429 bound holds exactly
+        under concurrent submissions, and returned on a failed append."""
+        self._admit_or_reject(job)
+        self.jobs[job.id] = job
+        self._queued_ids.add(job.id)
+        _DEPTH.set(len(self._queued_ids))
+        if self.journal is not None:
+            try:
+                await asyncio.to_thread(self.journal.append_submit, job)
+            except BaseException:
+                self._queued_ids.discard(job.id)
+                del self.jobs[job.id]
+                _DEPTH.set(len(self._queued_ids))
+                raise
+            if job.state.terminal:
+                # a DELETE landed during the append hop: cancel() found
+                # the id missing from the journal and its CANCELLED
+                # record was dropped — write the terminal record now or
+                # the entry stays live forever and the next boot
+                # resurrects a deliberately cancelled job
+                await asyncio.to_thread(
+                    self.journal.append_state, job.id, job.state, job.error
+                )
+                self.submitted += 1
+                _SUBMITTED.inc()
+                return job
+        self._q.put_nowait(job)
+        self.submitted += 1
+        _SUBMITTED.inc()
+        return job
+
+    def _admit_or_reject(self, job: ProofJob) -> None:
         depth = len(self._queued_ids)
         if depth >= self.bound:
             self.rejected += 1
@@ -110,13 +163,14 @@ class JobQueue:
             raise QueueFullError(
                 self.bound, depth, self.retry_after_hint(job.bucket)
             )
+
+    def _enqueue(self, job: ProofJob) -> None:
         self.jobs[job.id] = job
         self._queued_ids.add(job.id)
         self._q.put_nowait(job)
         self.submitted += 1
         _SUBMITTED.inc()
         _DEPTH.set(len(self._queued_ids))
-        return job
 
     def retry_after_hint(self, bucket: str | None = None) -> float:
         """Seconds until a queue slot plausibly frees: one full drain of
@@ -143,6 +197,8 @@ class JobQueue:
     def on_started(self, job: ProofJob) -> None:
         self._running_ids.add(job.id)
         _RUNNING.set(len(self._running_ids))
+        if self.journal is not None:
+            self.journal.append_state(job.id, JobState.RUNNING)
         if job.started_at is not None:
             _QUEUE_WAIT.observe(job.started_at - job.created_at)
 
@@ -156,6 +212,11 @@ class JobQueue:
         elif job.state is JobState.CANCELLED:
             self.cancelled += 1
         _FINISHED.labels(state=job.state.value).inc()
+        if self.journal is not None:
+            # idempotent: the shutdown paths (fail_terminal) journal the
+            # terminal record first, and the journal drops a second
+            # terminal append for an id it no longer holds live
+            self.journal.append_state(job.id, job.state, error=job.error)
         rt = job.runtime_s
         if rt is not None:
             b = job.bucket
@@ -206,6 +267,10 @@ class JobQueue:
             self._queued_ids.discard(job.id)
             _DEPTH.set(len(self._queued_ids))
             job.request_cancel()
+            if self.journal is not None:
+                # durable first: a crash right here must not resurrect a
+                # job the operator deliberately cancelled
+                self.journal.append_state(job.id, JobState.CANCELLED)
             job.mark_cancelled()
             self.cancelled += 1
             _FINISHED.labels(state=JobState.CANCELLED.value).inc()
@@ -213,6 +278,19 @@ class JobQueue:
         elif job.state is JobState.RUNNING:
             job.request_cancel()
         return job
+
+    def fail_terminal(self, job: ProofJob, exc: BaseException) -> None:
+        """Shutdown-drain path (WorkerPool.stop / BatchScheduler.stop):
+        journal the terminal failure BEFORE the in-memory transition. The
+        old order (mark_failed, then the on_finished journal write) left
+        a crash window in which a deliberately failed job was still
+        QUEUED on disk — the next boot would resurrect it."""
+        if self.journal is not None:
+            self.journal.append_state(
+                job.id, JobState.FAILED, error=error_dto(exc)
+            )
+        job.mark_failed(exc)
+        self.on_finished(job)
 
     def stats(self) -> dict:
         return {

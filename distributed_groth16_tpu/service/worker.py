@@ -120,20 +120,24 @@ class ProofExecutor:
 
     def _run(self, job: ProofJob) -> dict:
         timings = job.timings
+        job.note_phase("load")
         with phase("load", timings):
             r1cs, pk = self.store.load(job.circuit_id)
         job.check_cancel()
+        job.note_phase("witness")
         with phase("witness", timings):
             z = self.resolve_witness(job, r1cs)
         job.check_cancel()
         F = fr()
         z_mont = F.encode(z)
         if job.kind == "prove":
+            job.note_phase("prove")
             with phase("prove", timings):
                 comp = CompiledR1CS(r1cs)
                 proof = prove_single(pk, comp, z_mont)
         elif job.kind == "mpc_prove":
             pp = PackedSharingParams(job.l)
+            job.note_phase("packing")
             with phase("packing", timings):
                 comp = CompiledR1CS(r1cs)
                 qap_shares = comp.qap(z_mont).pss(pp)
@@ -157,6 +161,7 @@ class ProofExecutor:
             if aggregate.enabled():
                 aggregate.drain()
 
+            job.note_phase("MPC Proof")
             with phase("MPC Proof", timings):
                 res = run_round_with_retries(
                     pp.n,
@@ -170,6 +175,7 @@ class ProofExecutor:
             proof = reassemble_proof(res[0], pk)
         else:
             raise ValueError(f"unknown job kind {job.kind!r}")
+        job.note_phase(None)
         job.check_cancel()
         return {
             "circuitId": job.circuit_id,
@@ -216,10 +222,11 @@ class WorkerPool:
             await self.scheduler.stop()
         # jobs still QUEUED will never get a worker now — transition them
         # so sync waiters and status pollers see a terminal state instead
-        # of QUEUED forever (and of stalling graceful shutdown)
+        # of QUEUED forever (and of stalling graceful shutdown).
+        # fail_terminal journals the failure BEFORE the in-memory
+        # transition so a crash mid-shutdown can't resurrect them.
         for job in self.queue.drain_pending():
-            job.mark_failed(RuntimeError("service shutting down"))
-            self.queue.on_finished(job)
+            self.queue.fail_terminal(job, RuntimeError("service shutting down"))
 
     async def _worker(self, idx: int) -> None:
         while True:

@@ -37,11 +37,18 @@ KNOBS: dict[str, str] = {
     "DG16_SERVICE_ROUND_RETRIES": "transient-fault re-runs per MPC round",
     "DG16_SERVICE_RETRY_AFTER_S": "cold-start retryAfter hint seconds",
     "DG16_SERVICE_JOB_HISTORY": "terminal jobs kept addressable",
+    # crash safety (docs/ROBUSTNESS.md)
+    "DG16_JOURNAL": "durable job journal: dir, or 1 = <store>/_journal",
+    "DG16_JOURNAL_FSYNC": "fsync each journal append (default on)",
+    "DG16_JOURNAL_SEGMENT_RECORDS": "journal records per segment before compaction",
     # batching scheduler (docs/SCHEDULER.md)
     "DG16_BATCH_MAX": "jobs per batch; <=1 disables the scheduler",
     "DG16_BATCH_LINGER_MS": "partial-bucket wait for batchmates",
     "DG16_SCHED_MESHES": "cap on concurrently leased prover meshes",
     "DG16_SCHED_INFLIGHT": "scheduler backpressure bound",
+    "DG16_SCHED_POISON_RETRIES": "solo batch failures before quarantine",
+    "DG16_BREAKER_THRESHOLD": "slice failures tripping its breaker, <=0 off",
+    "DG16_BREAKER_COOLDOWN_S": "tripped-slice cooldown before half-open probe",
     # telemetry (docs/OBSERVABILITY.md)
     "DG16_METRICS": "metrics kill switch (default on; 0/false off)",
     "DG16_TRACE": "print Start:/End: phase lines",
@@ -176,6 +183,14 @@ class ServiceConfig:
       * job_history — how many terminal (DONE/FAILED/CANCELLED) jobs stay
         addressable via GET /jobs/{id}; older ones are evicted so a
         long-lived service doesn't grow its registry without bound.
+      * journal_dir — durable job-journal directory (service/journal.py):
+        "" disables, "1"/"true" means <store root>/_journal, anything
+        else is an explicit path. With it on, accepted jobs survive a
+        crash and are replayed at the next boot (docs/ROBUSTNESS.md).
+      * journal_fsync — fsync every journal append (the durability
+        contract; off trades it for speed in tests/throwaway replicas).
+      * journal_segment_records — appends per journal segment before a
+        compaction rewrites the live set and drops old segments.
     """
 
     workers: int = 2
@@ -184,6 +199,9 @@ class ServiceConfig:
     round_retries: int = 2
     retry_after_s: float = 5.0
     job_history: int = 1024
+    journal_dir: str = ""
+    journal_fsync: bool = True
+    journal_segment_records: int = 4096
 
     @staticmethod
     def from_env() -> "ServiceConfig":
@@ -194,6 +212,11 @@ class ServiceConfig:
             round_retries=env_int("DG16_SERVICE_ROUND_RETRIES", 2),
             retry_after_s=env_float("DG16_SERVICE_RETRY_AFTER_S", 5.0),
             job_history=env_int("DG16_SERVICE_JOB_HISTORY", 1024),
+            journal_dir=env_str("DG16_JOURNAL", ""),
+            journal_fsync=env_flag("DG16_JOURNAL_FSYNC", True),
+            journal_segment_records=env_int(
+                "DG16_JOURNAL_SEGMENT_RECORDS", 4096
+            ),
         )
 
 
@@ -214,12 +237,22 @@ class SchedulerConfig:
         (bucketed + batching). Workers stop feeding past it, so the
         queue refills and the 429 admission bound stays meaningful.
         0 = 4 x batch_max.
+      * poison_retries — how many times a job may kill its batch ALONE
+        (after bisection isolates it) before it is quarantined instead
+        of retried (docs/SCHEDULER.md "Poisoned batches").
+      * breaker_threshold — consecutive mesh-level batch failures that
+        trip a device slice's circuit breaker; <= 0 disables breakers.
+      * breaker_cooldown_s — seconds a tripped slice cools down before
+        a half-open probe batch may test it again.
     """
 
     batch_max: int = 1
     batch_linger_ms: float = 50.0
     max_meshes: int = 0
     max_inflight: int = 0
+    poison_retries: int = 2
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
 
     @staticmethod
     def from_env() -> "SchedulerConfig":
@@ -228,6 +261,9 @@ class SchedulerConfig:
             batch_linger_ms=env_float("DG16_BATCH_LINGER_MS", 50.0),
             max_meshes=env_int("DG16_SCHED_MESHES", 0),
             max_inflight=env_int("DG16_SCHED_INFLIGHT", 0),
+            poison_retries=env_int("DG16_SCHED_POISON_RETRIES", 2),
+            breaker_threshold=env_int("DG16_BREAKER_THRESHOLD", 3),
+            breaker_cooldown_s=env_float("DG16_BREAKER_COOLDOWN_S", 30.0),
         )
 
 

@@ -643,3 +643,82 @@ def test_round_retry_does_not_rerun_deterministic_protocol_misuse():
         run_round_with_retries(2, party, retries=3, net_cfg=FAST)
     assert not isinstance(ei.value, (MpcTimeoutError, MpcDisconnectError))
     assert state["rounds"] == 1, "deterministic failure must not be retried"
+
+
+# -- service-plane chaos: a worker dying mid-batch ---------------------------
+
+
+def test_kill_worker_mid_batch_jobs_survive(tmp_path):
+    """Chaos scenario for the crash-safe service plane
+    (docs/ROBUSTNESS.md): the batch prover's worker thread is killed
+    mid-batch (SystemExit, as an OOM-killed or crashed worker surfaces).
+    The scheduler must neither hang nor lose a job — the batch faults,
+    bisection retries the members, and every job lands DONE with the
+    journal holding no resurrectable state. Bounded like every other
+    scenario: a regression is a failure, not a wedged suite."""
+    from types import SimpleNamespace
+
+    from distributed_groth16_tpu.scheduler import BatchScheduler, ProverCache
+    from distributed_groth16_tpu.service import JobJournal, JobQueue, ProofJob
+    from distributed_groth16_tpu.service.jobs import JobState
+    from distributed_groth16_tpu.utils.config import SchedulerConfig
+
+    class _Executor:
+        class _Store:
+            def load(self, cid):
+                return (SimpleNamespace(num_instance=2),
+                        SimpleNamespace(domain_size=16))
+
+        store = _Store()
+
+    class _DyingProver:
+        """First execution dies ABRUPTLY (the kill), later ones work."""
+
+        def __init__(self):
+            self.provers = ProverCache()
+            self.kills = 1
+            self.runs = 0
+
+        def run_batch(self, jobs, key, mesh):
+            self.runs += 1
+            if self.kills > 0:
+                self.kills -= 1
+                raise SystemExit("worker killed mid-batch")
+            return [
+                (j, {"circuitId": j.circuit_id, "proof": [], "phases": {}})
+                for j in jobs
+            ]
+
+    async def scenario():
+        jdir = str(tmp_path / "wal")
+        q = JobQueue(bound=64, workers=2,
+                     journal=JobJournal(jdir, fsync=False))
+        sched = BatchScheduler(
+            _Executor(), q,
+            SchedulerConfig(batch_max=2, batch_linger_ms=60000.0,
+                            poison_retries=3),
+            devices=[object() for _ in range(8)],
+        )
+        prover = sched.batch_prover = _DyingProver()
+        jobs = [ProofJob(kind="prove", circuit_id="c1", fields={})
+                for _ in range(2)]
+        await sched.start()
+        try:
+            for job in jobs:
+                q.submit(job)
+                await q.get()
+                await sched.offer(job)
+            while sched._batch_tasks:
+                await asyncio.gather(*list(sched._batch_tasks),
+                                     return_exceptions=True)
+        finally:
+            await sched.stop()
+        # the kill cost one retry round, not the batch
+        assert all(j.state is JobState.DONE for j in jobs), [
+            (j.state, j.error) for j in jobs
+        ]
+        assert prover.runs > 1  # the batch really was re-driven
+        # nothing resurrectable: a rebuilt journal replays zero jobs
+        assert JobJournal(jdir, fsync=False).pending() == []
+
+    _bounded(scenario())

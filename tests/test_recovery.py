@@ -1,0 +1,615 @@
+"""Crash-safe service plane tests (service/journal.py + graceful drain +
+poisoned-batch bisection + mesh circuit breakers; docs/ROBUSTNESS.md).
+
+Covers the acceptance ladder: (a) a service killed mid-load — jobs
+QUEUED and one mid-RUNNING — is rebuilt from its on-disk journal and
+completes every journaled job with verifying proofs; (b) a batch holding
+one poisoned job completes all batchmates via bisection and quarantines
+exactly the poison; (c) a device slice with injected failures trips its
+breaker, placement routes around it, and a half-open probe recovers it;
+(d) SIGTERM-style drain flips /healthz, rejects admission with 503,
+finishes in-flight work, and checkpoints the journal to empty — plus
+units for segment compaction, torn-record tolerance, shutdown-ordering
+(journal-before-transition), failure-DTO sanitization, and the
+`dg16-cli job recover --dry-run` offline inspection path.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from distributed_groth16_tpu.api.server import ApiServer
+from distributed_groth16_tpu.api.store import CircuitStore
+from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
+from distributed_groth16_tpu.frontend.readers import write_r1cs, write_wtns
+from distributed_groth16_tpu.scheduler import (
+    BatchFault,
+    BatchScheduler,
+    DevicePool,
+    ProverCache,
+)
+from distributed_groth16_tpu.service import (
+    JobJournal,
+    JobQueue,
+    ProofJob,
+    read_journal,
+)
+from distributed_groth16_tpu.service.jobs import (
+    JobState,
+    error_dto,
+    sanitize_message,
+)
+from distributed_groth16_tpu.utils.config import SchedulerConfig, ServiceConfig
+
+POLL_DEADLINE_S = 300.0
+
+
+@pytest.fixture(scope="module")
+def circuit(tmp_path_factory):
+    """One saved circuit + witness shared by the module's service tests."""
+    cs = mult_chain_circuit(9, 7)
+    r1cs, z = cs.finish()
+    root = str(tmp_path_factory.mktemp("recovery_store"))
+    cid = CircuitStore(root).save_circuit("rec", write_r1cs(r1cs), b"")
+    publics = [str(x) for x in z[1 : r1cs.num_instance]]
+    return root, cid, write_wtns(z), publics
+
+
+def _server(root, jdir, **cfg_kw) -> ApiServer:
+    defaults = dict(
+        workers=2, queue_bound=64, crs_cache_size=8,
+        journal_dir=jdir, journal_fsync=False,
+    )
+    defaults.update(cfg_kw)
+    return ApiServer(CircuitStore(root), ServiceConfig(**defaults))
+
+
+async def _poll_terminal(client, job_id: str) -> dict:
+    deadline = time.monotonic() + POLL_DEADLINE_S
+    while time.monotonic() < deadline:
+        resp = await client.get(f"/jobs/{job_id}")
+        body = await resp.json()
+        assert resp.status == 200, body
+        if body["state"] in ("DONE", "FAILED", "CANCELLED"):
+            return body
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"job {job_id} never reached a terminal state")
+
+
+# -- journal units -----------------------------------------------------------
+
+
+def test_journal_round_trip_and_idempotent_resubmit(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d, fsync=False)
+    q = JobQueue(bound=8, workers=1, journal=j)
+    queued = q.submit(ProofJob(kind="prove", circuit_id="c1",
+                               fields={"witness_file": b"\x01\x02"}))
+    running = q.submit(ProofJob(kind="mpc_prove", circuit_id="c1",
+                                fields={"input_file": b"{}"}, l=2))
+    running.mark_running()
+    q.on_started(running)
+
+    # "crash": rebuild purely from disk
+    j2 = JobJournal(d, fsync=False)
+    pend = j2.pending()
+    assert [(e.id, e.state) for e in pend] == [
+        (queued.id, "QUEUED"), (running.id, "RUNNING"),
+    ]
+    assert pend[0].fields == {"witness_file": b"\x01\x02"}
+    assert pend[1].kind == "mpc_prove" and pend[1].l == 2
+
+    # idempotent re-submission: the journal records a requeue, not a
+    # duplicate payload, and a second reload sees each job exactly once
+    q2 = JobQueue(bound=8, workers=1, journal=j2)
+    for e in pend:
+        q2.submit(ProofJob(kind=e.kind, circuit_id=e.circuit_id,
+                           fields=e.fields, l=e.l, id=e.id,
+                           created_at=e.created_at))
+    assert len(JobJournal(d, fsync=False).pending()) == 2
+
+
+def test_journal_terminal_states_compact_away(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d, fsync=False, segment_records=16)
+    q = JobQueue(bound=64, workers=1, journal=j)
+
+    async def run():
+        for i in range(12):
+            job = q.submit(ProofJob(kind="prove", circuit_id="c", fields={}))
+            await q.get()
+            job.mark_running()
+            q.on_started(job)
+            job.mark_done({"proof": []})
+            q.on_finished(job)
+
+    asyncio.run(run())
+    # 12 jobs x (submit + RUNNING + DONE) = 36 appends >> 16/segment:
+    # compaction ran, and with everything terminal the journal is empty
+    assert j.pending() == []
+    assert JobJournal(d, fsync=False).pending() == []
+    segs = [n for n in os.listdir(d) if n.startswith("wal-")]
+    assert len(segs) <= 2  # old segments were deleted, not accumulated
+
+
+def test_journal_tolerates_torn_final_record(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d, fsync=False)
+    job = ProofJob(kind="prove", circuit_id="c", fields={"witness_file": b"x"})
+    j.append_submit(job)
+    seg = os.path.join(d, sorted(os.listdir(d))[-1])
+    with open(seg, "a") as f:
+        f.write('{"k": "state", "id": "' + job.id)  # torn mid-crash
+    pend = JobJournal(d, fsync=False).pending()
+    assert [e.id for e in pend] == [job.id]  # torn line dropped, job kept
+
+
+def test_journal_compaction_crash_window_never_resurrects(tmp_path):
+    """Crash artifact of a half-finished compaction: the OLD segment
+    holds a job's submit + terminal record, the fsynced NEW segment only
+    restates the submit (the concurrent terminal landed after the
+    snapshot, and the pending-flush never ran). Replay must keep the
+    job dead — the later submit record must not resurrect it."""
+    d = str(tmp_path / "wal")
+    os.makedirs(d)
+    sub = {"k": "submit", "id": "x1", "kind": "prove", "cid": "c",
+           "l": 2, "t": 1.0, "fields": {}}
+    done = {"k": "state", "id": "x1", "state": "DONE", "t": 2.0}
+    with open(os.path.join(d, "wal-00000001.jsonl"), "w") as f:
+        f.write(json.dumps(sub) + "\n" + json.dumps(done) + "\n")
+    with open(os.path.join(d, "wal-00000002.jsonl"), "w") as f:
+        f.write(json.dumps(sub) + "\n")  # snapshot restatement only
+    assert read_journal(d) == []
+    assert JobJournal(d, fsync=False).pending() == []
+
+
+def test_journal_quarantine_mark_blocks_replay(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d, fsync=False)
+    job = ProofJob(kind="prove", circuit_id="c", fields={})
+    j.append_submit(job)
+    j.append_quarantine(job.id, "poisoned")
+    # crash BEFORE the terminal record: the mark alone must block replay
+    j2 = JobJournal(d, fsync=False)
+    assert j2.pending() == []
+    assert [e.quarantined for e in read_journal(d)] == [True]
+    # ...and compaction purges the stranded mark — one such crash must
+    # not leave a permanent live record that survives every checkpoint
+    j2.checkpoint()
+    j2.close()
+    assert read_journal(d) == []
+    assert JobJournal(d, fsync=False).stats()["liveRecords"] == 0
+
+
+def test_shutdown_drain_journals_before_failing(tmp_path):
+    """Satellite: fail_terminal writes the durable FAILED record BEFORE
+    the in-memory transition — verified by journaling into a directory
+    we re-read: a deliberately failed job must never be replayable."""
+    d = str(tmp_path / "wal")
+    j = JobJournal(d, fsync=False)
+    q = JobQueue(bound=8, workers=1, journal=j)
+    job = q.submit(ProofJob(kind="prove", circuit_id="c", fields={}))
+    q.fail_terminal(job, RuntimeError("service shutting down"))
+    assert job.state is JobState.FAILED
+    assert JobJournal(d, fsync=False).pending() == []
+
+    async def run():
+        from distributed_groth16_tpu.service import WorkerPool
+
+        q2 = JobQueue(bound=8, workers=1, journal=JobJournal(d, fsync=False))
+        pool = WorkerPool(q2, object(), workers=1)
+        undrained = q2.submit(ProofJob(kind="prove", circuit_id="c", fields={}))
+        await pool.stop()
+        assert undrained.state is JobState.FAILED
+        assert "shutting down" in undrained.error["message"]
+
+    asyncio.run(run())
+    assert JobJournal(d, fsync=False).pending() == []
+
+
+def test_cancel_is_journaled_and_not_replayed(tmp_path):
+    d = str(tmp_path / "wal")
+    q = JobQueue(bound=8, workers=1, journal=JobJournal(d, fsync=False))
+    job = q.submit(ProofJob(kind="prove", circuit_id="c", fields={}))
+    q.cancel(job.id)
+    assert job.state is JobState.CANCELLED
+    assert JobJournal(d, fsync=False).pending() == []
+
+
+# -- failure-DTO sanitization (satellite regression) --------------------------
+
+
+def test_error_dto_sanitizes_paths_and_bigints():
+    leaky = ValueError(
+        "witness at /tmp/spool/job-123/upload.wtns mismatched "
+        "21888242871839275222246405745257275088548364400416034343698204186575808495617"
+    )
+    dto = error_dto(leaky, phase="witness")
+    assert dto["type"] == "ValueError" and dto["phase"] == "witness"
+    assert "/tmp/spool" not in dto["message"]
+    assert "<path>" in dto["message"]
+    assert "21888242871839" not in dto["message"]
+    assert "<bigint>" in dto["message"]
+    # ordinary small numbers and words survive
+    assert "mismatched" in dto["message"]
+    assert len(sanitize_message("x" * 10_000)) <= 301
+
+
+def test_mark_failed_carries_phase_and_sanitized_message():
+    job = ProofJob(kind="prove", circuit_id="c", fields={})
+    job.note_phase("load")
+    job.mark_failed(FileNotFoundError("/a/b/c/store/missing.r1cs"))
+    assert job.error == {
+        "type": "FileNotFoundError",
+        "message": "<path>",
+        "phase": "load",
+    }
+    assert job.to_dict()["error"]["phase"] == "load"
+
+
+# -- breaker units (placement) ------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_trips_routes_around_and_half_open_recovers():
+    async def run():
+        clk = _Clock()
+        pool = DevicePool(devices=[object() for _ in range(8)],
+                          breaker_threshold=2, breaker_cooldown_s=30.0,
+                          clock=clk)
+        # two consecutive failures on slot 0 trip its breaker
+        for _ in range(2):
+            lease = await pool.acquire(4)
+            assert lease.slot == 0
+            pool.report(lease, ok=False)
+            lease.release()
+        assert pool.stats()["breakers"] == {"4p0": "open"}
+        # placement now routes around the tripped slice
+        lease = await pool.acquire(4)
+        assert lease.slot == 1
+        pool.report(lease, ok=True)
+        lease.release()
+        # cooldown not yet over: still avoided
+        clk.t += 10.0
+        lease = await pool.acquire(4)
+        assert lease.slot == 1
+        lease.release()
+        # cooldown over: half-open admits ONE probe...
+        clk.t += 25.0
+        probe = await pool.acquire(4)
+        assert probe.slot == 0
+        assert pool.stats()["breakers"] == {"4p0": "half-open"}
+        # ...and a second concurrent acquire must not also probe slot 0
+        other = await pool.acquire(4)
+        assert other.slot == 1
+        other.release()
+        # probe success closes the breaker
+        pool.report(probe, ok=True)
+        probe.release()
+        assert pool.stats()["breakers"] == {}
+
+    asyncio.run(run())
+
+
+def test_breaker_failed_probe_reopens_cooldown():
+    async def run():
+        clk = _Clock()
+        pool = DevicePool(devices=[object() for _ in range(4)],
+                          breaker_threshold=1, breaker_cooldown_s=5.0,
+                          clock=clk)
+        lease = await pool.acquire(4)
+        pool.report(lease, ok=False)  # trips at threshold 1
+        lease.release()
+        clk.t += 6.0
+        probe = await pool.acquire(4)
+        pool.report(probe, ok=False)  # failed probe -> straight back open
+        probe.release()
+        assert pool.stats()["breakers"] == {"4p0": "open"}
+        # a waiter parks until the NEW cooldown lapses (real-time bounded
+        # wait keyed off the injected clock's remaining cooldown)
+        pool.breaker_cooldown_s = 0.2
+
+        async def advance():
+            await asyncio.sleep(0.05)
+            clk.t += 10.0
+
+        lease, _ = await asyncio.wait_for(
+            asyncio.gather(pool.acquire(4), advance()), 10
+        )
+        lease.release()
+
+    asyncio.run(run())
+
+
+def test_breaker_disabled_never_blocks():
+    async def run():
+        pool = DevicePool(devices=[object() for _ in range(4)],
+                          breaker_threshold=0)
+        for _ in range(5):
+            lease = await pool.acquire(4)
+            pool.report(lease, ok=False)
+            lease.release()
+        lease = await pool.acquire(4)
+        assert lease.slot == 0
+        lease.release()
+        assert pool.stats()["breakers"] == {}
+
+    asyncio.run(run())
+
+
+# -- bisection (stub prover, scheduler plumbing) ------------------------------
+
+
+class _StubExecutor:
+    class _Store:
+        def load(self, cid):
+            from types import SimpleNamespace
+
+            return (SimpleNamespace(num_instance=2),
+                    SimpleNamespace(domain_size=16))
+
+    store = _Store()
+
+
+class _PoisonProver:
+    """Mimics the real BatchProver's fault shape: a batch containing the
+    poisoned job dies WHOLE (one BatchFault for every member), any other
+    batch completes."""
+
+    def __init__(self, poison_ids=()):
+        self.poison_ids = set(poison_ids)
+        self.provers = ProverCache()
+        self.runs: list[list[str]] = []
+
+    def run_batch(self, jobs, key, mesh):
+        self.runs.append([j.id for j in jobs])
+        if any(j.id in self.poison_ids for j in jobs):
+            fault = BatchFault(RuntimeError("device program crashed"))
+            return [(j, fault) for j in jobs]
+        return [
+            (j, {"circuitId": j.circuit_id, "proof": [], "phases": {}})
+            for j in jobs
+        ]
+
+
+async def _feed(sched, q, jobs):
+    for job in jobs:
+        q.submit(job)
+        await q.get()
+        await sched.offer(job)
+    while sched._batch_tasks:
+        await asyncio.gather(*list(sched._batch_tasks),
+                             return_exceptions=True)
+
+
+@pytest.mark.parametrize("poison_idx", [0, 2])
+def test_bisection_isolates_exactly_the_poisoned_job(tmp_path, poison_idx):
+    """Both positions matter: a poison sorted BEFORE its successful
+    batchmates exhausts its solo retries before any success has been
+    observed — the quarantine verdict must be deferred until the whole
+    batch ran, not decided at exhaustion time (regression)."""
+
+    async def run():
+        jdir = str(tmp_path / "wal")
+        q = JobQueue(bound=64, workers=2,
+                     journal=JobJournal(jdir, fsync=False))
+        cfg = SchedulerConfig(batch_max=4, batch_linger_ms=60000.0,
+                              poison_retries=2)
+        sched = BatchScheduler(_StubExecutor(), q, cfg,
+                               devices=[object() for _ in range(8)])
+        jobs = [ProofJob(kind="prove", circuit_id="c1", fields={})
+                for _ in range(4)]
+        poison = jobs[poison_idx]
+        sched.batch_prover = _PoisonProver([poison.id])
+        await sched.start()
+        try:
+            await _feed(sched, q, jobs)
+        finally:
+            await sched.stop()
+        survivors = [j for j in jobs if j is not poison]
+        assert all(j.state is JobState.DONE for j in survivors)
+        assert poison.state is JobState.FAILED
+        assert poison.error["type"] == "PoisonedJobError"
+        assert sched.jobs_poisoned == 1
+        # quarantined on disk too: a replay must NOT resurrect the poison
+        assert JobJournal(jdir, fsync=False).pending() == []
+        # and the bisection actually split: more runs than one batch
+        assert len(sched.batch_prover.runs) > 1
+
+    asyncio.run(run())
+
+
+def test_whole_bad_batch_trips_breaker_without_quarantine_brands():
+    """When NOTHING succeeds on the slice the whole batch, the slice is
+    as suspect as the jobs: everyone fails with the underlying cause
+    (no PoisonedJobError brand, no journal quarantine mark — a
+    resubmission may land on a healthy slice) and the slice's breaker
+    trips on the consecutive mesh faults."""
+
+    async def run():
+        q = JobQueue(bound=64, workers=2)
+        cfg = SchedulerConfig(batch_max=2, batch_linger_ms=60000.0,
+                              poison_retries=1, breaker_threshold=1,
+                              breaker_cooldown_s=300.0)
+        sched = BatchScheduler(_StubExecutor(), q, cfg,
+                               devices=[object() for _ in range(8)])
+        jobs = [ProofJob(kind="prove", circuit_id="c1", fields={})
+                for _ in range(2)]
+        sched.batch_prover = _PoisonProver([j.id for j in jobs])
+        await sched.start()
+        try:
+            await _feed(sched, q, jobs)
+        finally:
+            await sched.stop()
+        assert all(j.state is JobState.FAILED for j in jobs)
+        assert all(j.error["type"] == "RuntimeError" for j in jobs)
+        assert sched.jobs_poisoned == 0
+        # zero successes + mesh-level faults: the slice's breaker tripped
+        assert sched.devices.stats()["breakers"] == {"8p0": "open"}
+
+    asyncio.run(run())
+
+
+# -- drain + restart recovery through the full HTTP stack ---------------------
+
+
+def test_restart_mid_load_completes_every_journaled_job(circuit):
+    """The acceptance criterion: a service killed with jobs QUEUED and
+    one mid-RUNNING is rebuilt over the same journal dir and completes
+    every journaled job with verifying proofs."""
+    root, cid, wtns, publics = circuit
+    jdir = os.path.join(root, "_journal_restart")
+
+    # incarnation 1: accept work, reach RUNNING, then "crash" (no stop(),
+    # no checkpoint — the object is simply dropped)
+    j1 = JobJournal(jdir, fsync=False)
+    q1 = JobQueue(bound=8, workers=1, journal=j1)
+    interrupted = q1.submit(ProofJob(
+        kind="mpc_prove", circuit_id=cid,
+        fields={"witness_file": wtns}, l=2,
+    ))
+    queued = q1.submit(ProofJob(
+        kind="prove", circuit_id=cid, fields={"witness_file": wtns},
+    ))
+    interrupted.mark_running()
+    q1.on_started(interrupted)
+    j1.close()
+    del q1, j1
+
+    from distributed_groth16_tpu.telemetry import metrics as telemetry_metrics
+
+    replayed = telemetry_metrics.registry().counter(
+        "journal_replayed_total", labelnames=("state",)
+    )
+    before = {
+        s: replayed.labels(state=s).value for s in ("QUEUED", "RUNNING")
+    }
+
+    # incarnation 2: a full ApiServer over the same store + journal
+    async def run():
+        server = _server(root, jdir, workers=2)
+        client = TestClient(TestServer(server.app()))
+        await client.start_server()
+        try:
+            for jid in (interrupted.id, queued.id):
+                status = await _poll_terminal(client, jid)
+                assert status["state"] == "DONE", status
+                resp = await client.get(f"/jobs/{jid}/result")
+                result = await resp.json()
+                assert resp.status == 200, result
+                resp = await client.post(
+                    "/verify_proof",
+                    json={
+                        "circuitId": cid,
+                        "proof": result["proof"],
+                        "publicInputs": publics,
+                    },
+                )
+                body = await resp.json()
+                assert resp.status == 200 and body["isValid"], body
+            resp = await client.get("/stats")
+            stats = await resp.json()
+            assert stats["journal"]["liveRecords"] == 0
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+    # clean shutdown checkpointed: a third boot would replay nothing
+    assert JobJournal(jdir, fsync=False).pending() == []
+    # the replay metric labels the state the CRASH interrupted — one job
+    # was mid-RUNNING, one still QUEUED (regression: re-submission used
+    # to requeue the entry before the label was read)
+    assert replayed.labels(state="RUNNING").value == before["RUNNING"] + 1
+    assert replayed.labels(state="QUEUED").value == before["QUEUED"] + 1
+
+
+def test_drain_flips_healthz_rejects_admission_finishes_inflight(circuit):
+    root, cid, wtns, publics = circuit
+    jdir = os.path.join(root, "_journal_drain")
+
+    async def run():
+        server = _server(root, jdir, workers=2)
+        client = TestClient(TestServer(server.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/jobs/prove",
+                data={"circuit_id": cid, "witness_file": wtns},
+            )
+            body = await resp.json()
+            assert resp.status == 202, body
+            jid = body["jobId"]
+
+            drain_task = asyncio.ensure_future(server.drain())
+            await asyncio.sleep(0)  # let the flag flip
+
+            # liveness stays 200 (a probe must not kill a draining
+            # replica); readiness flips to 503 to leave rotation
+            resp = await client.get("/healthz")
+            assert resp.status == 200
+            assert (await resp.json())["status"] == "draining"
+            resp = await client.get("/readyz")
+            assert resp.status == 503
+            assert (await resp.json())["status"] == "draining"
+
+            # admission is closed on the jobs API and the legacy routes
+            resp = await client.post(
+                "/jobs/prove",
+                data={"circuit_id": cid, "witness_file": wtns},
+            )
+            assert resp.status == 503
+            resp = await client.post(
+                "/create_proof_without_mpc",
+                data={"circuit_id": cid, "witness_file": wtns},
+            )
+            assert resp.status == 503
+
+            # ...but the in-flight job still completes, and drain returns
+            await asyncio.wait_for(drain_task, POLL_DEADLINE_S)
+            status = await _poll_terminal(client, jid)
+            assert status["state"] == "DONE", status
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+    # cleanup checkpointed an empty journal: nothing to replay
+    assert JobJournal(jdir, fsync=False).pending() == []
+
+
+# -- CLI offline inspection ---------------------------------------------------
+
+
+def test_cli_job_recover_dry_run_lists_replay_set(tmp_path, capsys):
+    from distributed_groth16_tpu.api.cli import main as cli_main
+
+    jdir = str(tmp_path / "store" / "_journal")
+    j = JobJournal(jdir, fsync=False)
+    q = JobQueue(bound=8, workers=1, journal=j)
+    live = q.submit(ProofJob(kind="prove", circuit_id="c1",
+                             fields={"witness_file": b"abc"}))
+    done = q.submit(ProofJob(kind="prove", circuit_id="c1", fields={}))
+    done.mark_running()
+    q.on_started(done)
+    done.mark_done({"proof": []})
+    q.on_finished(done)
+    j.close()
+
+    cli_main(["job", "recover", "--dry-run",
+              "--store", str(tmp_path / "store")])
+    out = json.loads(capsys.readouterr().out)
+    assert out["dryRun"] is True
+    assert [e["jobId"] for e in out["wouldReplay"]] == [live.id]
+    assert out["wouldReplay"][0]["payloadBytes"] == 3
+    # dry-run touched nothing: the journal still replays the same set
+    assert [e.id for e in JobJournal(jdir, fsync=False).pending()] == [live.id]

@@ -310,7 +310,7 @@ def test_scheduler_stop_fails_lingering_jobs_terminally():
         await sched.offer(job)
         await sched.stop()
         assert job.state is JobState.FAILED
-        assert "shutting down" in job.error["error"]
+        assert "shutting down" in job.error["message"]
 
     asyncio.run(run())
 

@@ -363,7 +363,7 @@ def test_pool_stop_preserves_finished_proof_and_fails_queued():
         assert j_running.result is not None
         # the job that never got a worker is terminal, not QUEUED forever
         assert j_queued.state is JobState.FAILED
-        assert "shutting down" in j_queued.error["error"]
+        assert "shutting down" in j_queued.error["message"]
         assert blocker.ran == [j_running.id]
 
     asyncio.run(run())
