@@ -34,6 +34,10 @@ routes above, funnels through one queue + bounded worker pool):
                           per-phase timing aggregates, batching-scheduler
                           bucket/placement state when DG16_BATCH_MAX > 1
                           (docs/SCHEDULER.md)
+  GET    /slo             SLO burn-rate document per job kind (enabled via
+                          DG16_SLO_TARGET_S / DG16_SLO_TARGETS; the
+                          per-replica signal a router/autoscaler polls —
+                          docs/OBSERVABILITY.md "SLO monitoring")
   GET    /metrics         Prometheus text exposition of the process-wide
                           telemetry registry (docs/OBSERVABILITY.md)
 
@@ -66,9 +70,11 @@ from ..service import (
     ProofExecutor,
     ProofJob,
     QueueFullError,
+    SloMonitor,
     WorkerPool,
 )
-from ..utils.config import SchedulerConfig, ServiceConfig
+from ..service.slo import disabled_doc as _slo_disabled
+from ..utils.config import SchedulerConfig, ServiceConfig, SLOConfig
 from .store import CircuitStore
 
 log = logging.getLogger(__name__)
@@ -124,10 +130,20 @@ class ApiServer:
         store: CircuitStore | None = None,
         cfg: ServiceConfig | None = None,
         sched_cfg: SchedulerConfig | None = None,
+        slo_cfg: SLOConfig | None = None,
     ):
         self.store = store or CircuitStore()
         self.cfg = cfg or ServiceConfig.from_env()
         self.sched_cfg = sched_cfg or SchedulerConfig.from_env()
+        self.slo_cfg = slo_cfg or SLOConfig.from_env()
+        # SLO burn-rate sampler (docs/OBSERVABILITY.md "SLO monitoring"):
+        # derives slo_burn_rate{kind}/slo_budget_remaining{kind} from the
+        # job_seconds series on a timer; DG16_SLO_TARGET_S <= 0 (and no
+        # per-kind targets) leaves the whole plane off
+        self.slo: SloMonitor | None = (
+            SloMonitor(self.slo_cfg) if self.slo_cfg.enabled else None
+        )
+        self._slo_task: asyncio.Task | None = None
         self.crs_cache = CrsCache(self.cfg.crs_cache_size)
         # durable job journal (DG16_JOURNAL, docs/ROBUSTNESS.md): with it
         # on, every accepted job is fsynced before the 202 and replayed
@@ -471,8 +487,20 @@ class ApiServer:
                     if self.scheduler is not None
                     else {"enabled": False}
                 ),
+                "slo": (
+                    self.slo.sample()
+                    if self.slo is not None
+                    else _slo_disabled()
+                ),
             }
         )
+
+    async def slo_status(self, request):
+        """The SLO document alone — what a router/autoscaler polls per
+        replica (sampled fresh, not waiting on the background timer)."""
+        if self.slo is None:
+            return web.json_response(_slo_disabled())
+        return web.json_response(self.slo.sample())
 
     async def metrics(self, request):
         """Prometheus text format 0.0.4 scrape endpoint."""
@@ -490,9 +518,26 @@ class ApiServer:
         # anything the fresh process admits
         self._replay_journal()
         await self.pool.start()
+        if self.slo is not None:
+            self._slo_task = asyncio.create_task(self._slo_loop())
         self._install_signal_handlers()
 
+    async def _slo_loop(self) -> None:
+        """Background burn-rate sampler: keeps the slo_* gauges fresh for
+        scrapes that never touch /slo or /stats."""
+        assert self.slo is not None
+        while True:
+            await asyncio.sleep(self.slo_cfg.sample_s)
+            self.slo.sample()
+
     async def _on_cleanup(self, app):
+        if self._slo_task is not None:
+            self._slo_task.cancel()
+            try:
+                await self._slo_task
+            except asyncio.CancelledError:
+                pass
+            self._slo_task = None
         await self.pool.stop()
         self._remove_signal_handlers()
         if self.journal is not None:
@@ -568,6 +613,7 @@ class ApiServer:
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)
         app.router.add_get("/stats", self.stats)
+        app.router.add_get("/slo", self.slo_status)
         app.router.add_get("/metrics", self.metrics)
         return app
 

@@ -31,11 +31,32 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import metrics as _tm
 from .constants import LIMB_BITS, N_LIMBS
 from .curve import CurvePoints, g1, g2
 
 # total scalar bits covered (BN254 Fr fits in 254 < 256)
 _SCALAR_BITS = 256
+
+# which implementation actually ran (docs/OBSERVABILITY.md): production
+# dashboards catch a TPU mesh silently falling back to the generic path.
+# Counted at dispatch time — under an enclosing jit that is once per
+# traced signature, not per execution.
+_ROUTE = _tm.registry().counter(
+    "kernel_route_total",
+    "Kernel-path routing decisions at dispatch/trace time, per kernel "
+    "and chosen implementation path",
+    ("kernel", "path"),
+)
+# pre-bound children (the metrics.py hot-path contract: one dict lookup
+# + add per record, no per-call label-tuple allocation)
+_R_TREE = _ROUTE.labels(kernel="msm", path="tree")
+_R_LADDER = _ROUTE.labels(kernel="msm", path="ladder")
+_R_PIPPENGER = _ROUTE.labels(kernel="msm", path="pippenger")
+_R_CHUNKED = _ROUTE.labels(kernel="msm", path="pippenger_chunked")
+_RB_TREE = _ROUTE.labels(kernel="msm_batched", path="tree")
+_RB_LADDER = _ROUTE.labels(kernel="msm_batched", path="ladder")
+_RB_VMAP = _ROUTE.labels(kernel="msm_batched", path="pippenger_vmap")
 
 
 def _digits_for_window(scalars, w, c: int):
@@ -169,8 +190,10 @@ def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
     if tree_g is not None:
         from .limb_kernels import msm_tree
 
+        _R_TREE.inc()
         return msm_tree(points, scalars, group=tree_g)
     if window_bits is None and chunk is None and n <= _LADDER_MSM_MAX_N:
+        _R_LADDER.inc()
         return _msm_ladder_jit(curve, points, scalars)
     if window_bits is None:
         # the sort+scan bucketing costs ~n log n adds per window, so fewer,
@@ -178,7 +201,9 @@ def msm(curve: CurvePoints, points, scalars, window_bits: int | None = None,
         window_bits = 16 if n >= (1 << 14) else 8 if n >= 64 else 4
     assert LIMB_BITS % window_bits == 0, "window must divide the 16-bit limb"
     if chunk is None or chunk >= n:
+        _R_PIPPENGER.inc()
         return _msm_jit(curve, points, scalars, window_bits)
+    _R_CHUNKED.inc()
     acc = curve.infinity()
     for s in range(0, n, chunk):
         part = _msm_jit(curve, points[s : s + chunk], scalars[s : s + chunk],
@@ -199,6 +224,7 @@ def msm_batched(curve: CurvePoints, bases, scalars_std):
     if tree_g is not None:
         from .limb_kernels import msm_tree
 
+        _RB_TREE.inc()
         return jnp.stack(
             [
                 msm_tree(bases[b], scalars_std[b], group=tree_g)
@@ -208,8 +234,10 @@ def msm_batched(curve: CurvePoints, bases, scalars_std):
     if n <= _LADDER_MSM_MAX_N:
         from .curve import scalar_bits
 
+        _RB_LADDER.inc()
         acc = curve.scalar_mul_bits(bases, scalar_bits(scalars_std))
         return curve.sum_sequential(acc, axis=1)
+    _RB_VMAP.inc()
     wbits = 16 if n >= (1 << 14) else 8 if n >= 64 else 4
     return jax.vmap(lambda bs, sc: _msm_jit(curve, bs, sc, wbits))(
         bases, scalars_std

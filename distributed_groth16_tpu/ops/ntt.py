@@ -22,9 +22,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import metrics as _tm
 from .constants import FR_GENERATOR, FR_TWO_ADICITY, N_LIMBS, R
 from .field import fr
 from .refmath import finv
+
+# same family ops/msm.py registers (idempotent): which NTT path ran —
+# dashboards catch a TPU backend silently on the row-major fallback
+_ROUTE = _tm.registry().counter(
+    "kernel_route_total",
+    "Kernel-path routing decisions at dispatch/trace time, per kernel "
+    "and chosen implementation path",
+    ("kernel", "path"),
+)
+_R_LIMB = _ROUTE.labels(kernel="ntt", path="limb")
+_R_ROW = _ROUTE.labels(kernel="ntt", path="row")
 
 
 def _tracing_active() -> bool:
@@ -173,7 +185,9 @@ class JaxDomain:
         if off is not None:
             x = F.mul(x, off)
         if _limb_ntt_ok(self.size):
+            _R_LIMB.inc()
             return _limb_ntt_route(x, self.size, False)
+        _R_ROW.inc()
         return _ntt_core(x, self._live_perm(), self._live_wpows(), self.logn)
 
     def ifft(self, evals):
@@ -181,8 +195,10 @@ class JaxDomain:
         F = fr()
         x = _zpad(evals, self.size)
         if _limb_ntt_ok(self.size):
+            _R_LIMB.inc()
             x = _limb_ntt_route(x, self.size, True)
         else:
+            _R_ROW.inc()
             x = _ntt_core(
                 x, self._live_perm(), self._live_wpows(), self.logn,
                 inverse=True,

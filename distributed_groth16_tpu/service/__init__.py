@@ -10,6 +10,7 @@ from .crs_cache import CrsCache
 from .jobs import JobCancelled, JobState, ProofJob, error_dto
 from .journal import JobJournal, JournalEntry, read_journal
 from .queue import JobQueue, QueueFullError
+from .slo import SloMonitor
 from .worker import ProofExecutor, WorkerPool
 
 __all__ = [
@@ -22,6 +23,7 @@ __all__ = [
     "ProofExecutor",
     "ProofJob",
     "QueueFullError",
+    "SloMonitor",
     "WorkerPool",
     "error_dto",
     "read_journal",

@@ -5,6 +5,11 @@ path (aggregate.py) — the fault flight recorder (flight.py), and JAX
 compile-cost accounting (compile.py). Every layer — transport,
 distributed kernels, prover, service, API, bench — records through here;
 docs/OBSERVABILITY.md is the catalog and naming convention.
+
+The performance observatory (perf.py registry + runner, perf_kernels.py
+cases, benchgate.py regression gate) is NOT imported here: it pulls in
+ops/ and is loaded lazily by its consumers (`tools/benchgate`,
+`dg16-cli perf`, bench.py) so importing the spine stays cheap.
 """
 
 from . import aggregate, flight, metrics, tracing  # noqa: F401

@@ -42,6 +42,16 @@ DEFAULT_TIME_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, INF,
 )
 
+# kernel-latency buckets (perf_kernel_* families, telemetry/perf.py): a
+# warm NTT at 2^10 is tens of microseconds on TPU — DEFAULT_TIME_BUCKETS'
+# 1 ms floor would collapse every fast kernel into one bucket, hiding the
+# exact curve-bending the per-kernel bench exists to show
+DEFAULT_KERNEL_BUCKETS = (
+    0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, INF,
+)
+
 _ENABLED = _config.env_flag("DG16_METRICS", True)
 
 
@@ -189,6 +199,12 @@ class _Family:
                 return [((), self._default)]
             return sorted(self._children.items())
 
+    def items(self) -> list[tuple[tuple, object]]:
+        """Snapshot of (label-values, child) pairs — the read side for
+        derived samplers (service/slo.py) that fold existing series into
+        new gauges instead of instrumenting call sites twice."""
+        return self._items()
+
 
 class CounterFamily(_Family):
     kind = "counter"
@@ -287,6 +303,13 @@ class MetricsRegistry:
         return self._get(
             HistogramFamily, name, help, labelnames, buckets=buckets
         )
+
+    def family(self, name) -> _Family | None:
+        """Look a family up by name WITHOUT registering it — None when the
+        registering module was never imported (the reader must treat that
+        as 'no data', not create a typeless placeholder)."""
+        with self._lock:
+            return self._families.get(name)
 
     def snapshot(self) -> dict[str, float]:
         """Flat {series: value} map (histograms as _sum/_count) — the

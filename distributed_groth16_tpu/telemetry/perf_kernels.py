@@ -1,0 +1,224 @@
+"""The registered perf-kernel cases — the real hot-path primitives.
+
+Each builder constructs one `KernelCase` for `telemetry/perf.py`: setup
+(random scalars, distinct bases via one windowed fixed-base batch mul,
+twiddle/limb layout) happens here, OUTSIDE the timed region, mirroring
+bench.py's ADVICE r5 #8 discipline. Device cases hand the underlying
+jitted entry points themselves (`_msm_jit`, `_msm_tree_jit`, `ntt_limb`,
+`_fixed_base_jit`) so XLA introspection sees exactly the program the
+prover runs; host cases (GLV decomposition, the Miller loop, scalar limb
+packing) are pure-Python reference kernels timed for trend, not roofline.
+
+Sizes are log2(n). `sizes=` is the full (TPU-scale) sweep matching the
+Groth16 domain sizes the ROADMAP benches; `quick=` is the CPU smoke
+subset `tools/benchgate --quick` and the CI perf-smoke lane run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .perf import KernelCase, perf_kernel
+
+
+def _rng(log2n: int, salt: int = 0) -> np.random.Generator:
+    return np.random.default_rng(0xD616 + 257 * salt + log2n)
+
+
+def _rand_ints(n: int, mod: int, rng: np.random.Generator) -> list[int]:
+    return [int.from_bytes(rng.bytes(40), "little") % mod for _ in range(n)]
+
+
+def _scalars_std(log2n: int, salt: int = 0):
+    from ..ops.constants import R
+    from ..ops.msm import encode_scalars_std
+
+    n = 1 << log2n
+    return encode_scalars_std(_rand_ints(n, R, _rng(log2n, salt)))
+
+
+def _distinct_bases(which: str, log2n: int):
+    """n DISTINCT random points k_i * G via one windowed fixed-base batch
+    mul — setup-only, excluded from timing (the ADVICE r5 #8 rule: an MSM
+    over a broadcast generator flatters the memory system)."""
+    import jax
+
+    from ..ops.fixedbase import fixed_base_mul
+
+    return jax.block_until_ready(
+        fixed_base_mul(which, _scalars_std(log2n, salt=1))
+    )
+
+
+# -- MSM ---------------------------------------------------------------------
+
+
+@perf_kernel("msm_g1", sizes=(12, 14, 16), quick=(8,),
+             unit="scalar-muls/sec")
+def _msm_g1(log2n: int) -> KernelCase:
+    from ..ops.curve import g1
+    from ..ops.msm import _msm_jit
+
+    n = 1 << log2n
+    c = 16 if n >= (1 << 14) else 8
+    return KernelCase(
+        _msm_jit, (g1(), _distinct_bases("g1", log2n), _scalars_std(log2n), c),
+        n,
+    )
+
+
+@perf_kernel("msm_g2", sizes=(12, 14), quick=(8,), unit="scalar-muls/sec")
+def _msm_g2(log2n: int) -> KernelCase:
+    from ..ops.curve import g2
+    from ..ops.msm import _msm_jit
+
+    n = 1 << log2n
+    c = 16 if n >= (1 << 14) else 8
+    return KernelCase(
+        _msm_jit, (g2(), _distinct_bases("g2", log2n), _scalars_std(log2n), c),
+        n,
+    )
+
+
+@perf_kernel("msm_g1_tree", sizes=(12, 16, 20), quick=(10,),
+             unit="scalar-muls/sec")
+def _msm_g1_tree(log2n: int) -> KernelCase:
+    """The limb-major Pallas tree path — the BENCH headline kernel (runs
+    as bit-identical plain XLA off-TPU)."""
+    from ..ops.limb_kernels import _msm_tree_jit, lg1
+
+    n = 1 << log2n
+    return KernelCase(
+        _msm_tree_jit,
+        (lg1(), _distinct_bases("g1", log2n), _scalars_std(log2n), 8, None),
+        n,
+    )
+
+
+# -- NTT ---------------------------------------------------------------------
+
+
+def _fr_vector(log2n: int):
+    from ..ops.constants import R
+    from ..ops.field import fr
+
+    n = 1 << log2n
+    return fr().encode(_rand_ints(n, R, _rng(log2n, salt=2)))
+
+
+def _ntt_case(log2n: int, inverse: bool) -> KernelCase:
+    import jax
+
+    from ..ops.ntt import domain
+
+    n = 1 << log2n
+    d = domain(n)
+
+    def run(x):
+        return d.ifft(x) if inverse else d.fft(x)
+
+    return KernelCase(jax.jit(run), (_fr_vector(log2n),), n)
+
+
+@perf_kernel("ntt_fwd", sizes=(12, 15, 20), quick=(10,), unit="coeffs/sec")
+def _ntt_fwd(log2n: int) -> KernelCase:
+    return _ntt_case(log2n, inverse=False)
+
+
+@perf_kernel("ntt_inv", sizes=(12, 15, 20), quick=(10,), unit="coeffs/sec")
+def _ntt_inv(log2n: int) -> KernelCase:
+    return _ntt_case(log2n, inverse=True)
+
+
+def _limb_vector(log2n: int):
+    import jax.numpy as jnp
+
+    from ..ops.limb_kernels import NL
+
+    n = 1 << log2n
+    return jnp.asarray(
+        _rng(log2n, salt=3).integers(0, 1 << 16, size=(NL, n), dtype=np.uint32)
+    )
+
+
+@perf_kernel("ntt_limb_fwd", sizes=(12, 15, 20), quick=(10,),
+             unit="coeffs/sec")
+def _ntt_limb_fwd(log2n: int) -> KernelCase:
+    from ..ops.ntt_limb import ntt_limb
+
+    n = 1 << log2n
+    return KernelCase(ntt_limb, (_limb_vector(log2n), n, False), n)
+
+
+@perf_kernel("ntt_limb_inv", sizes=(12, 15, 20), quick=(10,),
+             unit="coeffs/sec")
+def _ntt_limb_inv(log2n: int) -> KernelCase:
+    from ..ops.ntt_limb import ntt_limb
+
+    n = 1 << log2n
+    return KernelCase(ntt_limb, (_limb_vector(log2n), n, True), n)
+
+
+# -- fixed-base / setup ------------------------------------------------------
+
+
+@perf_kernel("fixedbase_g1", sizes=(12, 15), quick=(10,),
+             unit="scalar-muls/sec")
+def _fixedbase_g1(log2n: int) -> KernelCase:
+    from ..ops.curve import g1
+    from ..ops.fixedbase import _fixed_base_jit, generator_table
+
+    n = 1 << log2n
+    return KernelCase(
+        _fixed_base_jit, (g1(), generator_table("g1"), _scalars_std(log2n)),
+        n,
+    )
+
+
+# -- host reference kernels --------------------------------------------------
+
+
+@perf_kernel("glv_decompose", sizes=(12,), quick=(10,), unit="scalars/sec",
+             host=True)
+def _glv_decompose(log2n: int) -> KernelCase:
+    from ..ops.constants import R
+    from ..ops.glv import bn254_g1_glv
+
+    n = 1 << log2n
+    params = bn254_g1_glv()  # precompute (lattice basis) outside timing
+    ks = _rand_ints(n, R, _rng(log2n, salt=4))
+
+    def run():
+        for k in ks:
+            params.decompose(k)
+
+    return KernelCase(run, (), n)
+
+
+@perf_kernel("pairing_miller_loop", sizes=(0,), quick=(0,),
+             unit="pairings/sec", host=True)
+def _pairing_miller_loop(log2n: int) -> KernelCase:
+    from ..ops.constants import G1_GENERATOR, G2_GENERATOR
+    from ..ops.pairing import miller_loop
+
+    def run():
+        miller_loop(G2_GENERATOR, G1_GENERATOR)
+
+    return KernelCase(run, (), 1)
+
+
+@perf_kernel("scalar_pack", sizes=(14,), quick=(12,), unit="scalars/sec",
+             host=True)
+def _scalar_pack(log2n: int) -> KernelCase:
+    """Host-side limb conversion (int -> (n, 16) standard-form u32): the
+    per-job scalar packing tax every submission pays before any kernel."""
+    from ..ops.constants import R
+    from ..ops.msm import encode_scalars_std
+
+    n = 1 << log2n
+    vals = _rand_ints(n, R, _rng(log2n, salt=5))
+
+    def run():
+        encode_scalars_std(vals)
+
+    return KernelCase(run, (), n)

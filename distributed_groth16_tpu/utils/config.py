@@ -56,6 +56,16 @@ KNOBS: dict[str, str] = {
     "DG16_AGG": "star-wide trace aggregation plane (default off)",
     "DG16_FLIGHT_DIR": "flight-recorder post-mortem directory",
     "DG16_FLIGHT_ARTIFACT_DIR": "chaos-suite flight-dump dir (CI upload)",
+    # performance observatory (docs/PERF.md, docs/OBSERVABILITY.md)
+    "DG16_PERF_REPS": "benchgate warm reps per kernel case",
+    "DG16_PERF_REL_THRESHOLD": "benchgate relative slowdown gate",
+    "DG16_PERF_ABS_FLOOR_S": "benchgate absolute-seconds noise floor",
+    # SLO burn-rate monitoring (docs/OBSERVABILITY.md)
+    "DG16_SLO_TARGET_S": "default job-latency SLO target, <=0 off",
+    "DG16_SLO_TARGETS": "per-kind latency targets, kind=seconds CSV",
+    "DG16_SLO_OBJECTIVE": "fraction of jobs that must meet the target",
+    "DG16_SLO_WINDOW_S": "error-budget accounting window",
+    "DG16_SLO_SAMPLE_S": "SLO sampler period",
     # kernels / JAX (docs/PERF.md)
     "DG16_NO_JAX_CACHE": "disable the persistent compilation cache",
     "DG16_JAX_CACHE": "explicit compilation-cache directory",
@@ -264,6 +274,72 @@ class SchedulerConfig:
             poison_retries=env_int("DG16_SCHED_POISON_RETRIES", 2),
             breaker_threshold=env_int("DG16_BREAKER_THRESHOLD", 3),
             breaker_cooldown_s=env_float("DG16_BREAKER_COOLDOWN_S", 30.0),
+        )
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """Service-level-objective knobs (service/slo.py, the burn-rate
+    sampler behind `/slo` and the `slo_burn_rate{kind}` gauges). The SLO
+    is a latency objective per job kind: at least `objective` of a kind's
+    terminal jobs must finish within that kind's target seconds; the
+    remainder is the error budget, accounted over a rolling `window_s`.
+
+      * target_s — default latency target (seconds) for any kind without
+        an explicit entry in `targets`. <= 0 disables SLO monitoring
+        entirely (no sampler task, `/stats` reports enabled: false).
+      * targets — per-kind overrides, parsed from the DG16_SLO_TARGETS
+        CSV (`prove=30,mpc_prove=120`).
+      * objective — fraction of jobs that must meet the target (0.99 =
+        a 1% error budget).
+      * window_s — rolling window the budget is accounted over.
+      * sample_s — how often the background sampler re-derives the
+        burn-rate gauges from the job_seconds series.
+    """
+
+    target_s: float = 0.0
+    targets: tuple = ()
+    objective: float = 0.99
+    window_s: float = 3600.0
+    sample_s: float = 5.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.target_s > 0 or bool(self.targets)
+
+    def target_for(self, kind: str) -> float:
+        for k, v in self.targets:
+            if k == kind:
+                return v
+        return self.target_s
+
+    @staticmethod
+    def parse_targets(spec: str) -> tuple:
+        """`prove=30,mpc_prove=120` -> (("prove", 30.0), ...). Malformed
+        entries raise ValueError — a silently ignored SLO is worse than a
+        loud boot failure."""
+        out = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, val = part.partition("=")
+            if not kind or not val:
+                raise ValueError(
+                    f"bad DG16_SLO_TARGETS entry {part!r} "
+                    "(expected kind=seconds)"
+                )
+            out.append((kind.strip(), float(val)))
+        return tuple(out)
+
+    @staticmethod
+    def from_env() -> "SLOConfig":
+        return SLOConfig(
+            target_s=env_float("DG16_SLO_TARGET_S", 0.0),
+            targets=SLOConfig.parse_targets(env_str("DG16_SLO_TARGETS", "")),
+            objective=env_float("DG16_SLO_OBJECTIVE", 0.99),
+            window_s=env_float("DG16_SLO_WINDOW_S", 3600.0),
+            sample_s=env_float("DG16_SLO_SAMPLE_S", 5.0),
         )
 
 
