@@ -24,6 +24,8 @@ Usage:
       [--journal DIR | --store DIR]
   python -m distributed_groth16_tpu.api.cli trace JOB [--out trace.json]
   python -m distributed_groth16_tpu.api.cli metrics
+  python -m distributed_groth16_tpu.api.cli fleet status
+  python -m distributed_groth16_tpu.api.cli fleet drain REPLICA
   python -m distributed_groth16_tpu.api.cli perf run [--quick] \
       [--select msm_g1 ...] [--out perf.json]
   python -m distributed_groth16_tpu.api.cli perf top --run perf.json [-n 10]
@@ -225,6 +227,64 @@ def cmd_metrics(args) -> dict:
         )
     print(resp.text, end="")
     raise SystemExit(0)
+
+
+_FLEET_COLUMNS = (
+    # (header, /fleet/stats replica-row key)
+    ("REPLICA", "replicaId"),
+    ("STATE", "state"),
+    ("SCORE", "score"),
+    ("QUEUED", "queueDepth"),
+    ("RUNNING", "running"),
+    ("WORKERS", "workers"),
+    ("DEVICES", "devices"),
+    ("BREAKERS", "openBreakers"),
+    ("BURN", "maxBurnRate"),
+    ("URL", "url"),
+)
+
+
+def format_fleet_table(stats: dict) -> str:
+    """The `fleet status` table: one row per replica plus a footer of
+    router-level counters. Pure string building — unit-testable without
+    a server."""
+    rows = [[h for h, _ in _FLEET_COLUMNS]]
+    for r in stats.get("replicas", []):
+        rows.append(
+            ["-" if r.get(k) is None else str(r[k]) for _, k in _FLEET_COLUMNS]
+        )
+    widths = [max(len(row[i]) for row in rows) for i in range(len(rows[0]))]
+    lines = [
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in rows
+    ]
+    tenants = stats.get("tenants", {})
+    lines.append(
+        f"pending={stats.get('pending', 0)} "
+        f"handoffs={stats.get('handoffs', 0)} "
+        f"admitted={tenants.get('admitted', 0)} "
+        f"rejected={tenants.get('rejected', 0)}"
+    )
+    return "\n".join(lines)
+
+
+def cmd_fleet_status(args) -> dict:
+    """GET /fleet/stats off the ROUTER (--url should point at the fleet
+    front door, not a replica) and print the replica table."""
+    stats = _body(requests.get(f"{args.url}/fleet/stats", timeout=60))
+    print(format_fleet_table(stats))
+    raise SystemExit(0)
+
+
+def cmd_fleet_drain(args) -> dict:
+    """POST /fleet/drain/{replica} — ask the router to drain one replica
+    (by reported id or URL) and hand its journaled backlog off NOW; no
+    SIGTERM access to the replica host needed (docs/FLEET.md)."""
+    return _body(
+        requests.post(
+            f"{args.url}/fleet/drain/{args.replica}", timeout=120
+        )
+    )
 
 
 def cmd_perf_run(args) -> dict:
@@ -435,6 +495,24 @@ def main(argv=None) -> None:
         "metrics", help="dump the server's /metrics Prometheus text"
     )
     sp.set_defaults(fn=cmd_metrics)
+
+    fp = sub.add_parser(
+        "fleet",
+        help="fleet-router control plane: replica table, operator drain "
+             "(docs/FLEET.md; --url points at the router)",
+    )
+    fsub = fp.add_subparsers(dest="fleet_cmd", required=True)
+
+    sp = fsub.add_parser("status", help="tabular replica table")
+    sp.set_defaults(fn=cmd_fleet_status)
+
+    sp = fsub.add_parser(
+        "drain",
+        help="drain one replica via the router and hand its journaled "
+             "jobs off to healthy replicas",
+    )
+    sp.add_argument("replica", help="replica id (or config URL)")
+    sp.set_defaults(fn=cmd_fleet_drain)
 
     perf_p = sub.add_parser(
         "perf",

@@ -27,9 +27,16 @@ routes above, funnels through one queue + bounded worker pool):
                           cooperatively at the next phase boundary)
   GET    /healthz         liveness + pool shape (always 200 while the
                           process lives; body flips to "draining")
-  GET    /readyz          readiness: HTTP 503 once a SIGTERM drain began,
-                          so the balancer pulls the replica while
-                          in-flight work finishes
+  GET    /readyz          readiness + fleet capacity document: HTTP 503
+                          once a drain began so the balancer pulls the
+                          replica; the JSON body carries replica id,
+                          device inventory, open breakers, drain flag,
+                          queue shape and SLO burn — everything the fleet
+                          router reads in one poll (docs/FLEET.md)
+  POST   /drain           begin a graceful drain WITHOUT SIGTERM access
+                          (the router's `dg16-cli fleet drain` path):
+                          admission closes, in-flight work finishes, the
+                          process stays up
   GET    /stats           queue depth/counters, CRS-cache hit rate,
                           per-phase timing aggregates, batching-scheduler
                           bucket/placement state when DG16_BATCH_MAX > 1
@@ -56,6 +63,7 @@ import logging
 import os
 import signal
 import time
+import uuid
 
 from aiohttp import web
 
@@ -136,6 +144,9 @@ class ApiServer:
         self.cfg = cfg or ServiceConfig.from_env()
         self.sched_cfg = sched_cfg or SchedulerConfig.from_env()
         self.slo_cfg = slo_cfg or SLOConfig.from_env()
+        # fleet identity (docs/FLEET.md): what this replica calls itself
+        # in its /readyz capacity document and the router's replica table
+        self.replica_id = self.cfg.replica_id or f"r-{uuid.uuid4().hex[:8]}"
         # SLO burn-rate sampler (docs/OBSERVABILITY.md "SLO monitoring"):
         # derives slo_burn_rate{kind}/slo_budget_remaining{kind} from the
         # job_seconds series on a timer; DG16_SLO_TARGET_S <= 0 (and no
@@ -176,7 +187,8 @@ class ApiServer:
             from ..scheduler import BatchScheduler
 
             self.scheduler = BatchScheduler(
-                self.executor, self.queue, self.sched_cfg
+                self.executor, self.queue, self.sched_cfg,
+                slo_target_s=self.slo_cfg.target_s,
             )
         self.pool = WorkerPool(
             self.queue, self.executor, self.cfg.workers,
@@ -185,20 +197,42 @@ class ApiServer:
 
     # -- job plumbing --------------------------------------------------------
 
-    async def _submit(self, fields: dict[str, bytes], kind: str) -> ProofJob:
+    async def _submit(
+        self, fields: dict[str, bytes], kind: str, request=None
+    ) -> ProofJob:
         """Build + enqueue a ProofJob from multipart fields. Raises
         KeyError/ValueError on malformed submissions (mapped to 500 by the
         callers, CustomError-style), QueueFullError past the bound, and
         DrainingError (503) once a graceful drain began. Async because
-        the journal fsync runs off the loop (queue.submit_async)."""
+        the journal fsync runs off the loop (queue.submit_async).
+
+        Fleet hooks (docs/FLEET.md): the X-DG16-Tenant / X-DG16-Priority
+        headers stamp the job's identity, and a caller-supplied `job_id`
+        field makes submission IDEMPOTENT — a re-submission of a known id
+        (the router handing a dead replica's journal off while that
+        replica replays it itself) returns the existing job instead of
+        proving twice."""
         if self.draining:
             raise DrainingError("service is draining; not accepting jobs")
+        job_id = fields.get("job_id", b"").decode().strip()
+        if job_id:
+            existing = self.queue.jobs.get(job_id)
+            if existing is not None:
+                return existing
         circuit_id = fields["circuit_id"].decode()
+        tenant = priority = ""
+        if request is not None:
+            tenant = request.headers.get("X-DG16-Tenant", "").strip()
+            priority = request.headers.get("X-DG16-Priority", "").strip()
+        kwargs = {"id": job_id} if job_id else {}
         job = ProofJob(
             kind=kind,
             circuit_id=circuit_id,
             fields={k: fields[k] for k in _JOB_FIELDS if k in fields},
             l=int(fields.get("l", b"2").decode()),
+            tenant=tenant,
+            priority=priority,
+            **kwargs,
         )
         return await self.queue.submit_async(job)
 
@@ -220,6 +254,8 @@ class ApiServer:
                 circuit_id=entry.circuit_id,
                 fields=dict(entry.fields),
                 l=entry.l,
+                tenant=entry.tenant,
+                priority=entry.priority,
                 id=entry.id,
                 created_at=entry.created_at,
             )
@@ -262,7 +298,7 @@ class ApiServer:
         """The legacy synchronous routes: enqueue, then block the request
         (not the loop) until the job is terminal."""
         fields = await _read_multipart(request)
-        job = await self._submit(fields, kind)
+        job = await self._submit(fields, kind, request=request)
         await job.wait()
         return job
 
@@ -375,7 +411,9 @@ class ApiServer:
         try:
             fields = await _read_multipart(request)
             mpc = fields.get("mpc", b"").decode().lower() in ("1", "true", "yes")
-            job = await self._submit(fields, "mpc_prove" if mpc else "prove")
+            job = await self._submit(
+                fields, "mpc_prove" if mpc else "prove", request=request
+            )
         except QueueFullError as e:
             return _busy(e)
         except DrainingError as e:
@@ -466,11 +504,60 @@ class ApiServer:
         )
 
     async def readyz(self, request):
-        """READINESS: 503 while draining so the load balancer pulls the
-        replica out of rotation while in-flight proofs finish
-        (docs/ROBUSTNESS.md "Graceful drain")."""
-        body = {"status": "draining" if self.draining else "ok"}
+        """READINESS + capacity document (docs/FLEET.md): 503 while
+        draining so a balancer pulls the replica, and a JSON body that
+        tells the fleet router everything discovery needs in ONE poll —
+        replica id, device inventory size, open mesh-breaker count, the
+        drain flag, the live queue shape, and the worst SLO burn rate
+        across kinds. /healthz keeps its original liveness body."""
+        s = self.queue.stats()
+        open_breakers = 0
+        devices = 0
+        if self.scheduler is not None:
+            placement = self.scheduler.devices.stats()
+            devices = placement["devices"]
+            open_breakers = sum(
+                1 for st in placement["breakers"].values() if st != "closed"
+            )
+        max_burn = 0.0
+        if self.slo is not None:
+            doc = self.slo.sample()
+            burns = [k["burnRate"] for k in doc["kinds"].values()]
+            max_burn = max(burns) if burns else 0.0
+        body = {
+            "status": "draining" if self.draining else "ok",
+            "replicaId": self.replica_id,
+            "draining": self.draining,
+            "devices": devices,
+            "openBreakers": open_breakers,
+            "workers": s["workers"],
+            "queueDepth": s["queueDepth"],
+            "queueBound": s["queueBound"],
+            "running": s["running"],
+            "maxBurnRate": round(max_burn, 4),
+        }
         return web.json_response(body, status=503 if self.draining else 200)
+
+    async def drain_route(self, request):
+        """POST /drain — operator/router-initiated graceful drain without
+        SIGTERM access to the process (`dg16-cli fleet drain`,
+        docs/FLEET.md): admission closes, /readyz flips 503, lingering
+        buckets flush early, in-flight jobs finish. Unlike the SIGTERM
+        path the process does NOT exit — a drained replica sits idle,
+        journal checkpointed by whatever stops it later. Idempotent."""
+        already = self.draining
+        self.begin_drain()
+        if self.scheduler is not None and not already:
+            # early-flush lingering buckets like the SIGTERM drain does,
+            # but without blocking the request on in-flight work
+            self.scheduler.flush_lingering()
+        return web.json_response(
+            {
+                "status": "draining",
+                "replicaId": self.replica_id,
+                "alreadyDraining": already,
+            }
+        )
 
     async def stats(self, request):
         return web.json_response(
@@ -612,6 +699,7 @@ class ApiServer:
         app.router.add_delete("/jobs/{job_id}", self.job_cancel)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)
+        app.router.add_post("/drain", self.drain_route)
         app.router.add_get("/stats", self.stats)
         app.router.add_get("/slo", self.slo_status)
         app.router.add_get("/metrics", self.metrics)

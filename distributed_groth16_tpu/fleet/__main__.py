@@ -1,0 +1,6 @@
+"""`python -m distributed_groth16_tpu.fleet` — run the fleet router."""
+
+from .router import main
+
+if __name__ == "__main__":
+    main()

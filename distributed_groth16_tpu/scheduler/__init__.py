@@ -107,12 +107,19 @@ class BatchScheduler:
     """
 
     def __init__(self, executor, queue, cfg: SchedulerConfig | None = None,
-                 devices=None):
+                 devices=None, slo_target_s: float = 0.0):
         self.executor = executor
         self.queue = queue
         self.cfg = cfg or SchedulerConfig.from_env()
+        # deadline-aware linger (docs/FLEET.md): with a target, a bucket
+        # may not linger past half the SLO target of its oldest job.
+        # Explicit-only (ApiServer passes SLOConfig.target_s): reading
+        # DG16_SLO_TARGET_S here would let an ambient env var flip
+        # fake-clock scheduler tests onto the wall clock.
         self.bucketer = Bucketer(
-            self.cfg.batch_max, self.cfg.batch_linger_ms / 1000.0
+            self.cfg.batch_max,
+            self.cfg.batch_linger_ms / 1000.0,
+            slo_target_s=slo_target_s,
         )
         self.devices = DevicePool(
             devices,
@@ -164,14 +171,20 @@ class BatchScheduler:
         if self._batch_tasks:
             await asyncio.gather(*self._batch_tasks, return_exceptions=True)
 
-    async def drain(self) -> None:
-        """Graceful-drain hook (SIGTERM, docs/ROBUSTNESS.md): release
-        every lingering bucket NOW — a partial batch at drain time proves
-        immediately instead of waiting out its linger — and wait for all
-        in-flight batches to finish. Unlike stop(), nothing is failed and
-        the linger loop keeps running for any still-arriving jobs."""
+    def flush_lingering(self) -> None:
+        """Release every lingering bucket NOW — a partial batch at drain
+        time proves immediately instead of waiting out its linger. The
+        non-blocking half of drain(); also the POST /drain route's hook
+        (docs/FLEET.md)."""
         for batch in self.bucketer.flush():
             self._spawn(batch)
+
+    async def drain(self) -> None:
+        """Graceful-drain hook (SIGTERM, docs/ROBUSTNESS.md): release
+        every lingering bucket NOW and wait for all in-flight batches to
+        finish. Unlike stop(), nothing is failed and the linger loop
+        keeps running for any still-arriving jobs."""
+        self.flush_lingering()
         while self._batch_tasks:
             await asyncio.gather(*list(self._batch_tasks),
                                  return_exceptions=True)

@@ -9,6 +9,15 @@ jobs or when its oldest job has lingered `linger_s` seconds — the classic
 size-or-deadline tradeoff: a full batch maximizes amortization, the
 linger deadline bounds the latency a lone job pays for it.
 
+Deadline-aware release (docs/FLEET.md, ROADMAP "linger less when an SLO
+is near"): with an SLO target configured (`slo_target_s` =
+DG16_SLO_TARGET_S), a job that already burned queue-wait before reaching
+its bucket gets LESS linger — the bucket may only linger while the
+oldest job's total age stays under half the target, reserving the other
+half for proving. A fresh job lingers the full `linger_s`; a job whose
+age already crossed the half-target releases on the next tick. Without
+an SLO target the linger is unconditional (the pre-fleet behavior).
+
 Pure event-loop-side bookkeeping: no locks, no I/O, injectable clock.
 The orchestration (who calls `add` / `pop_expired`, who runs released
 batches) lives in `scheduler/__init__.py`.
@@ -89,15 +98,43 @@ class _Bucket:
     deadline: float = 0.0  # oldest job's linger deadline
 
 
+# how much of the SLO target a job may spend WAITING (queue + linger)
+# before its bucket must release: the other half is reserved for the
+# proving round itself
+_SLO_WAIT_FRACTION = 0.5
+
+
 class Bucketer:
-    def __init__(self, batch_max: int, linger_s: float, clock=time.monotonic):
+    def __init__(
+        self,
+        batch_max: int,
+        linger_s: float,
+        clock=time.monotonic,
+        slo_target_s: float = 0.0,
+        age_of=None,
+    ):
         self.batch_max = max(1, batch_max)
         self.linger_s = max(0.0, linger_s)
         self.clock = clock
+        # deadline-aware release: <= 0 disables (unconditional linger).
+        # `age_of` maps a job to its seconds-since-submission — injectable
+        # (with `clock`) so the SLO-shortened linger is unit-testable
+        # without wall-clock sleeps; the default reads ProofJob.created_at
+        # against the wall clock, which is what job age means in an SLO.
+        self.slo_target_s = slo_target_s
+        self.age_of = age_of or (lambda job: time.time() - job.created_at)
         self._buckets: dict[BucketKey, _Bucket] = {}
 
     def __len__(self) -> int:
         return sum(len(b.jobs) for b in self._buckets.values())
+
+    def _linger_for(self, job) -> float:
+        """This job's linger allowance: the configured linger, shortened
+        by however much of its SLO wait budget the queue already spent."""
+        if self.slo_target_s <= 0:
+            return self.linger_s
+        budget = _SLO_WAIT_FRACTION * self.slo_target_s - self.age_of(job)
+        return min(self.linger_s, max(0.0, budget))
 
     def add(self, job, key: BucketKey) -> Batch | None:
         """Admit one job. Returns a released Batch when this admission
@@ -106,7 +143,13 @@ class Bucketer:
         now = self.clock()
         b = self._buckets.get(key)
         if b is None:
-            b = self._buckets[key] = _Bucket(key=key, deadline=now + self.linger_s)
+            b = self._buckets[key] = _Bucket(
+                key=key, deadline=now + self._linger_for(job)
+            )
+        else:
+            # the TIGHTEST member deadline governs the bucket: an aged
+            # job joining a fresh bucket must still release in time
+            b.deadline = min(b.deadline, now + self._linger_for(job))
         b.jobs.append(job)
         b.enqueued_at.append(now)
         _OCCUPANCY.labels(bucket=key.label).set(len(b.jobs))

@@ -91,6 +91,13 @@ class ProofJob:
     circuit_id: str
     fields: dict[str, bytes]
     l: int = 2
+    # fleet identity (docs/FLEET.md): which tenant submitted the job
+    # (X-DG16-Tenant at the router/replica door) and its priority class.
+    # Pure metadata at the replica — quotas and weighted-fair dispatch
+    # are enforced at the router; here they ride the DTO and the journal
+    # so a handoff re-routes under the right tenant.
+    tenant: str = ""
+    priority: str = ""
     id: str = field(default_factory=lambda: uuid.uuid4().hex)
     state: JobState = JobState.QUEUED
     created_at: float = field(default_factory=time.time)
@@ -253,6 +260,8 @@ class ProofJob:
             "jobId": self.id,
             "kind": self.kind,
             "circuitId": self.circuit_id,
+            "tenant": self.tenant,
+            "priority": self.priority,
             "state": self.state.value,
             "createdAt": self.created_at,
             "startedAt": self.started_at,

@@ -30,7 +30,8 @@ throwaway replicas).
 
 Record grammar (one JSON object per line):
 
-  {"k": "submit", "id", "kind", "cid", "l", "t", "fields": {name: b64}}
+  {"k": "submit", "id", "kind", "cid", "l", "t", "fields": {name: b64},
+   ["tenant", "priority"]}
   {"k": "state",  "id", "state", "t", ["error": {type,message,phase}]}
   {"k": "quarantine", "id", "t", "reason"}
   {"k": "checkpoint", "t"}          # clean-shutdown marker
@@ -81,6 +82,25 @@ _LIVE = _REG.gauge(
 _SEGMENT_PREFIX = "wal-"
 _SEGMENT_SUFFIX = ".jsonl"
 
+
+def _submit_record(e: "JournalEntry") -> dict:
+    """The submit-record shape — shared by the live append and the
+    compaction rewrite so the two can never drift."""
+    rec = {
+        "k": "submit",
+        "id": e.id,
+        "kind": e.kind,
+        "cid": e.circuit_id,
+        "l": e.l,
+        "t": e.created_at,
+        "fields": _encode_fields(e.fields),
+    }
+    if e.tenant:
+        rec["tenant"] = e.tenant
+    if e.priority:
+        rec["priority"] = e.priority
+    return rec
+
 _TERMINAL = {JobState.DONE.value, JobState.FAILED.value, JobState.CANCELLED.value}
 
 
@@ -96,6 +116,10 @@ class JournalEntry:
     fields: dict[str, bytes] = field(default_factory=dict, repr=False)
     state: str = JobState.QUEUED.value
     quarantined: bool = False
+    # fleet metadata (docs/FLEET.md): a handoff must re-route the job
+    # under the tenant that submitted it, so identity rides the WAL
+    tenant: str = ""
+    priority: str = ""
 
     @property
     def replayable(self) -> bool:
@@ -136,6 +160,8 @@ def _apply_record(
             l=int(rec.get("l", 2)),
             created_at=float(rec.get("t", 0.0)),
             fields=_decode_fields(rec.get("fields", {})),
+            tenant=rec.get("tenant", ""),
+            priority=rec.get("priority", ""),
         )
     elif k == "state":
         e = live.get(rec.get("id"))
@@ -281,26 +307,18 @@ class JobJournal:
                     "state",
                 )
             else:
-                self._live[job.id] = JournalEntry(
+                e = JournalEntry(
                     id=job.id,
                     kind=job.kind,
                     circuit_id=job.circuit_id,
                     l=job.l,
                     created_at=job.created_at,
                     fields=dict(job.fields),
+                    tenant=getattr(job, "tenant", ""),
+                    priority=getattr(job, "priority", ""),
                 )
-                ripe = self._append(
-                    {
-                        "k": "submit",
-                        "id": job.id,
-                        "kind": job.kind,
-                        "cid": job.circuit_id,
-                        "l": job.l,
-                        "t": job.created_at,
-                        "fields": _encode_fields(job.fields),
-                    },
-                    "submit",
-                )
+                self._live[job.id] = e
+                ripe = self._append(_submit_record(e), "submit")
             _LIVE.set(len(self._live))
         if ripe:
             self._compact()
@@ -381,16 +399,7 @@ class JobJournal:
         n = 0
         for e in snapshot:
             nfh.write(json.dumps(
-                {
-                    "k": "submit",
-                    "id": e.id,
-                    "kind": e.kind,
-                    "cid": e.circuit_id,
-                    "l": e.l,
-                    "t": e.created_at,
-                    "fields": _encode_fields(e.fields),
-                },
-                separators=(",", ":"),
+                _submit_record(e), separators=(",", ":")
             ) + "\n")
             n += 1
             state = e.state  # one read: may be mutated by a live append,

@@ -193,6 +193,19 @@ class _Family:
                 c = self._children[values] = self._child()
             return c
 
+    def remove(self, *values, **kw) -> None:
+        """Drop one child series, if it exists. For label MIGRATION —
+        e.g. a fleet replica adopting its self-reported id after first
+        contact — where leaving the old series exported would show a
+        phantom forever. Not for routine cleanup: dropping a live
+        counter child loses its count."""
+        if kw:
+            values = tuple(str(kw[n]) for n in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        with self._lock:
+            self._children.pop(values, None)
+
     def _items(self) -> list[tuple[tuple, object]]:
         with self._lock:
             if self._default is not None:

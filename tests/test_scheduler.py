@@ -129,6 +129,53 @@ def test_bucketer_linger_deadline_and_flush():
     assert len(b) == 0 and b.next_deadline() is None
 
 
+def test_bucketer_slo_shortens_linger_for_aged_jobs():
+    """Deadline-aware release (docs/FLEET.md): with an SLO target, a job
+    that already burned queue-wait lingers LESS — the bucket may only
+    wait while the oldest member's age stays under half the target. Both
+    clocks are injected, so no sleeping."""
+    clk = _Clock()  # the bucketer's monotonic clock (deadline units)
+    wall = {"t": 5000.0}  # job-age clock
+
+    def age_of(job):
+        return wall["t"] - job.created_at
+
+    b = Bucketer(
+        batch_max=8, linger_s=10.0, clock=clk,
+        slo_target_s=60.0, age_of=age_of,
+    )
+    # a FRESH job gets the full linger: wait budget 30s >> linger 10s
+    fresh = _job("c1")
+    fresh.created_at = wall["t"]
+    assert b.add(fresh, _key("c1")) is None
+    assert b.next_deadline() == pytest.approx(1010.0)
+
+    # an AGED job (28s old, 2s of wait budget left) joining the SAME
+    # bucket tightens the shared deadline to its remaining budget
+    aged = _job("c1")
+    aged.created_at = wall["t"] - 28.0
+    assert b.add(aged, _key("c1")) is None
+    assert b.next_deadline() == pytest.approx(1002.0)
+    assert b.pop_expired() == []
+    clk.t = 1002.5
+    released = b.pop_expired()
+    assert len(released) == 1 and len(released[0].jobs) == 2
+
+    # an OVERDUE job (past half the target) gets zero linger: it
+    # releases on the very next tick instead of waiting out the linger
+    overdue = _job("c2")
+    overdue.created_at = wall["t"] - 45.0
+    assert b.add(overdue, _key("c2")) is None
+    assert b.next_deadline() == pytest.approx(clk.t)
+    assert len(b.pop_expired()) == 1
+
+    # without an SLO target the aged job would have lingered fully —
+    # the pre-fleet behavior is preserved when the knob is off
+    b_off = Bucketer(batch_max=8, linger_s=10.0, clock=clk, age_of=age_of)
+    b_off.add(aged, _key("c1"))
+    assert b_off.next_deadline() == pytest.approx(clk.t + 10.0)
+
+
 # -- placement units ---------------------------------------------------------
 
 
