@@ -22,9 +22,11 @@ Usage:
       [--interval 2] [--out proof.bin]
   python -m distributed_groth16_tpu.api.cli job recover --dry-run \
       [--journal DIR | --store DIR]
-  python -m distributed_groth16_tpu.api.cli trace JOB [--out trace.json]
+  python -m distributed_groth16_tpu.api.cli trace JOB [--out trace.json] \
+      [--router http://router:8080]
   python -m distributed_groth16_tpu.api.cli metrics
   python -m distributed_groth16_tpu.api.cli fleet status
+  python -m distributed_groth16_tpu.api.cli fleet top [--interval 2] [--once]
   python -m distributed_groth16_tpu.api.cli fleet drain REPLICA
   python -m distributed_groth16_tpu.api.cli perf run [--quick] \
       [--select msm_g1 ...] [--out perf.json]
@@ -201,20 +203,43 @@ def cmd_job_recover(args) -> dict:
 
 
 def cmd_trace(args) -> dict:
-    """GET /jobs/{id}/trace — fetch a job's Chrome trace-event JSON and
-    write it to --out (default trace-<jobId>.json); open the file in
-    chrome://tracing or Perfetto (docs/OBSERVABILITY.md)."""
-    trace = _body(
-        requests.get(f"{args.url}/jobs/{args.job_id}/trace", timeout=600)
-    )
+    """Fetch a job's Chrome trace-event JSON and write it to --out
+    (default trace-<jobId>.json); open the file in chrome://tracing or
+    Perfetto (docs/OBSERVABILITY.md). With --router, the STITCHED fleet
+    trace (router + replica + MPC-party tiers) is fetched from
+    GET /fleet/jobs/{id}/trace first, falling back to the replica route
+    at --url when the id is unknown to the router (a job submitted
+    straight to a replica)."""
+    trace = None
+    source = args.url
+    router = getattr(args, "router", None)
+    if router:
+        resp = requests.get(
+            f"{router}/fleet/jobs/{args.job_id}/trace", timeout=600
+        )
+        if resp.status_code == 200:
+            trace = resp.json()
+            source = router
+        elif resp.status_code != 404:
+            raise SystemExit(
+                f"router error: HTTP {resp.status_code} — {resp.text[:300]}"
+            )
+    if trace is None:
+        trace = _body(
+            requests.get(f"{args.url}/jobs/{args.job_id}/trace", timeout=600)
+        )
     out = args.out or f"trace-{args.job_id}.json"
     with open(out, "w") as f:
         json.dump(trace, f)
-    return {
+    result = {
         "jobId": args.job_id,
+        "source": source,
         "out": out,
         "events": len(trace.get("traceEvents", [])),
     }
+    if trace.get("traceId"):
+        result["traceId"] = trace["traceId"]
+    return result
 
 
 def cmd_metrics(args) -> dict:
@@ -274,6 +299,118 @@ def cmd_fleet_status(args) -> dict:
     stats = _body(requests.get(f"{args.url}/fleet/stats", timeout=60))
     print(format_fleet_table(stats))
     raise SystemExit(0)
+
+
+_TOP_COLUMNS = (
+    "REPLICA", "STATE", "SCORE", "QUEUED", "RUNNING",
+    "P95(s)", "BURN", "BREAKERS", "STRAGGLER",
+)
+
+
+def _fmt_cell(v, digits=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def format_fleet_top(stats: dict, metrics_text: str) -> str:
+    """The `fleet top` frame: the /fleet/stats replica table enriched
+    with the federated /fleet/metrics view — per-replica job p95 (merged
+    across kinds), SLO burn, open breakers, and the party that straggles
+    most — plus a fleet-rollup footer. Pure string building, so it is
+    unit-testable with canned documents."""
+    from ..telemetry.metrics import (
+        histogram_quantile,
+        histogram_snapshots,
+        parse_exposition,
+    )
+
+    fams = parse_exposition(metrics_text) if metrics_text else {}
+    p95 = {}
+    js = fams.get("job_seconds")
+    if js is not None:
+        for (rep,), snap in histogram_snapshots(
+            js, group_by=("replica",)
+        ).items():
+            if snap.count:
+                p95[rep] = histogram_quantile(snap, 0.95)
+    stragglers: dict[str, tuple[float, str]] = {}
+    st = fams.get("party_straggler_total")
+    if st is not None:
+        for _, labels, value in st.samples:
+            rep, party = labels.get("replica", ""), labels.get("party")
+            if party is None:
+                continue
+            if value > stragglers.get(rep, (0.0, ""))[0]:
+                stragglers[rep] = (value, party)
+    rows = [list(_TOP_COLUMNS)]
+    for r in stats.get("replicas", []):
+        rid = r.get("replicaId", "")
+        rows.append([
+            _fmt_cell(rid),
+            _fmt_cell(r.get("state")),
+            _fmt_cell(r.get("score")),
+            _fmt_cell(r.get("queueDepth")),
+            _fmt_cell(r.get("running")),
+            _fmt_cell(p95.get(rid)),
+            _fmt_cell(r.get("maxBurnRate")),
+            _fmt_cell(r.get("openBreakers")),
+            _fmt_cell(stragglers.get(rid, (0.0, None))[1]),
+        ])
+    widths = [max(len(row[i]) for row in rows) for i in range(len(rows[0]))]
+    lines = [
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in rows
+    ]
+    # fleet-rollup footer from the federated families
+    footer = []
+    fq = fams.get("fleet_job_quantile_seconds")
+    if fq is not None:
+        by_kind: dict[str, dict[str, float]] = {}
+        for _, labels, value in fq.samples:
+            by_kind.setdefault(labels.get("kind", ""), {})[
+                labels.get("q", "")
+            ] = value
+        for kind in sorted(by_kind):
+            qs = by_kind[kind]
+            footer.append(
+                f"{kind}: p50={_fmt_cell(qs.get('0.5'))}s "
+                f"p95={_fmt_cell(qs.get('0.95'))}s"
+            )
+    for gname, label in (
+        ("fleet_jobs_per_second", "jobs/s"),
+        ("fleet_max_burn_rate", "max-burn"),
+        ("fleet_open_breakers", "open-breakers"),
+    ):
+        fam = fams.get(gname)
+        if fam is not None and fam.samples:
+            footer.append(f"{label}={_fmt_cell(fam.samples[0][2])}")
+    footer.append(f"pending={stats.get('pending', 0)}")
+    footer.append(f"handoffs={stats.get('handoffs', 0)}")
+    lines.append("  ".join(footer))
+    return "\n".join(lines)
+
+
+def cmd_fleet_top(args) -> dict:
+    """Live operator view: re-render the enriched replica table from
+    /fleet/stats + /fleet/metrics every --interval seconds (--once for a
+    single frame, e.g. in scripts)."""
+    import time as _time
+
+    while True:
+        stats = _body(requests.get(f"{args.url}/fleet/stats", timeout=60))
+        resp = requests.get(f"{args.url}/fleet/metrics", timeout=60)
+        table = format_fleet_top(
+            stats, resp.text if resp.status_code == 200 else ""
+        )
+        if args.once:
+            print(table)
+            raise SystemExit(0)
+        # clear + home, then the frame — a plain-ANSI `top`
+        print("\x1b[2J\x1b[H" + table, flush=True)
+        _time.sleep(args.interval)
 
 
 def cmd_fleet_drain(args) -> dict:
@@ -484,9 +621,16 @@ def main(argv=None) -> None:
 
     sp = sub.add_parser(
         "trace",
-        help="fetch a job's merged Chrome trace (GET /jobs/{id}/trace)",
+        help="fetch a job's merged Chrome trace (GET /jobs/{id}/trace); "
+             "--router fetches the stitched fleet trace instead",
     )
     sp.add_argument("job_id", help="job id from `job submit`")
+    sp.add_argument("--router", default=None,
+                    help="fleet router URL: fetch the stitched "
+                         "router+replica+MPC trace from "
+                         "/fleet/jobs/{id}/trace, falling back to the "
+                         "replica route at --url when the router does "
+                         "not know the id")
     sp.add_argument("--out", default=None,
                     help="output path (default trace-<jobId>.json)")
     sp.set_defaults(fn=cmd_trace)
@@ -505,6 +649,17 @@ def main(argv=None) -> None:
 
     sp = fsub.add_parser("status", help="tabular replica table")
     sp.set_defaults(fn=cmd_fleet_status)
+
+    sp = fsub.add_parser(
+        "top",
+        help="live-refreshing operator view: replica table enriched "
+             "with federated p95/burn/straggler from /fleet/metrics",
+    )
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period seconds")
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (for scripts)")
+    sp.set_defaults(fn=cmd_fleet_top)
 
     sp = fsub.add_parser(
         "drain",

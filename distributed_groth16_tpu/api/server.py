@@ -70,6 +70,7 @@ from aiohttp import web
 from ..frontend.ark_serde import proof_from_bytes
 from ..models.groth16 import verify
 from ..telemetry import metrics as telemetry_metrics
+from ..telemetry.aggregate import now_ns as _trace_now_ns
 from ..service import (
     CrsCache,
     JobJournal,
@@ -220,10 +221,15 @@ class ApiServer:
             if existing is not None:
                 return existing
         circuit_id = fields["circuit_id"].decode()
-        tenant = priority = ""
+        tenant = priority = trace_id = ""
         if request is not None:
             tenant = request.headers.get("X-DG16-Tenant", "").strip()
             priority = request.headers.get("X-DG16-Priority", "").strip()
+            # trace context (docs/OBSERVABILITY.md "Fleet observatory"):
+            # the router mints one trace id per job and propagates it in
+            # X-DG16-Trace; a direct submission mints its own here so
+            # every job has a trace whether or not a router fronted it
+            trace_id = request.headers.get("X-DG16-Trace", "").strip()
         kwargs = {"id": job_id} if job_id else {}
         job = ProofJob(
             kind=kind,
@@ -232,6 +238,7 @@ class ApiServer:
             l=int(fields.get("l", b"2").decode()),
             tenant=tenant,
             priority=priority,
+            trace_id=trace_id or uuid.uuid4().hex,
             **kwargs,
         )
         return await self.queue.submit_async(job)
@@ -256,6 +263,9 @@ class ApiServer:
                 l=entry.l,
                 tenant=entry.tenant,
                 priority=entry.priority,
+                # the crash must not break the end-to-end trace: the
+                # replayed job re-proves under the journaled trace id
+                trace_id=entry.trace_id or uuid.uuid4().hex,
                 id=entry.id,
                 created_at=entry.created_at,
             )
@@ -509,7 +519,15 @@ class ApiServer:
         tells the fleet router everything discovery needs in ONE poll —
         replica id, device inventory size, open mesh-breaker count, the
         drain flag, the live queue shape, and the worst SLO burn rate
-        across kinds. /healthz keeps its original liveness body."""
+        across kinds. /healthz keeps its original liveness body.
+
+        Clock echo (docs/OBSERVABILITY.md "Fleet observatory"): a poll
+        carrying `?echo=<t0_ns>` gets a `clockEcho` block back —
+        {t0 echoed, t1 receipt, t2 send} over perf_counter_ns, the same
+        clock span timestamps use — one NTP-style sample per poll, so
+        the router can rebase this replica's trace events onto its own
+        timeline when stitching the fleet trace."""
+        t1_ns = _trace_now_ns()
         s = self.queue.stats()
         open_breakers = 0
         devices = 0
@@ -536,6 +554,16 @@ class ApiServer:
             "running": s["running"],
             "maxBurnRate": round(max_burn, 4),
         }
+        echo = request.query.get("echo")
+        if echo is not None:
+            try:
+                body["clockEcho"] = {
+                    "t0": int(echo),
+                    "t1": t1_ns,
+                    "t2": _trace_now_ns(),
+                }
+            except ValueError:
+                pass  # malformed echo: answer the capacity doc anyway
         return web.json_response(body, status=503 if self.draining else 200)
 
     async def drain_route(self, request):

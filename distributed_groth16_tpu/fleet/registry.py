@@ -34,6 +34,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..telemetry import metrics as _tm
+from ..telemetry.aggregate import ClockSync
 
 _REG = _tm.registry()
 _SCORE = _REG.gauge(
@@ -78,6 +79,10 @@ class Replica:
     handoff_done: bool = False  # this outage's backlog already re-routed
     polls_ok: int = 0
     polls_failed: int = 0
+    # NTP-style offset estimate (replica_clock − router_clock) fed by
+    # the /readyz poll's clock echo — what rebases this replica's trace
+    # events onto the router's timeline in the stitched fleet trace
+    clock: ClockSync = field(default_factory=ClockSync, repr=False)
 
     @property
     def name(self) -> str:
@@ -250,6 +255,11 @@ class ReplicaRegistry:
                     "journal": r.journal_dir,
                     "pollsOk": r.polls_ok,
                     "pollsFailed": r.polls_failed,
+                    "clockOffsetS": (
+                        round(r.clock.offset_ns / 1e9, 6)
+                        if r.clock.n_samples
+                        else None
+                    ),
                 }
             )
         return rows

@@ -34,6 +34,15 @@ SAME job id. If the "dead" replica was merely slow and replays its own
 journal too, both sides converge: submission is idempotent by job id on
 every replica and in every journal, so the job proves at most once per
 replica and the client sees one terminal state. Nothing accepted is lost.
+
+Fleet observatory (docs/OBSERVABILITY.md): the router mints a `trace_id`
+next to the job id and propagates it in `X-DG16-Trace`; every hop the
+job takes at the front door is a router-side span, and
+`GET /fleet/jobs/{id}/trace` stitches them with the replica's merged job
+trace (ClockSync-rebased from /readyz poll echoes) into ONE Chrome
+trace. The discovery loop also scrapes each replica's `/metrics` and
+`GET /fleet/metrics` federates them (fleet/federate.py); an anomaly hook
+flight-dumps replicas whose p95/burn deviates from the fleet median.
 """
 
 from __future__ import annotations
@@ -42,6 +51,7 @@ import asyncio
 import json
 import logging
 import os
+import statistics
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -51,8 +61,11 @@ import aiohttp
 from aiohttp import web
 
 from ..service.journal import read_journal
+from ..telemetry import flight as _flight
 from ..telemetry import metrics as _tm
+from ..telemetry.aggregate import ClockSync, now_ns as _now_ns
 from ..utils.config import FleetConfig, TenantConfig
+from .federate import MetricsFederator
 from .registry import ACTIVE, DRAINING, EJECTED, Replica, ReplicaRegistry
 from .tenants import (
     DEFAULT_PRIORITY,
@@ -68,6 +81,15 @@ MAX_BODY = 100 * 1024 * 1024  # mirror the replica body cap
 
 _TERMINAL = ("DONE", "FAILED", "CANCELLED")
 
+# the router's track id in stitched fleet traces: far above any MPC party
+# pid (a replica trace uses 0..n-1), so the three tiers never collide
+ROUTER_PID = 9999
+
+# per-job router span cap: a job normally records a handful (admission,
+# one queue wait, one dispatch); a pathological requeue loop must not
+# grow an unbounded event list on a retained job
+MAX_JOB_SPANS = 256
+
 _REG = _tm.registry()
 _ROUTED = _REG.counter(
     "fleet_jobs_routed_total",
@@ -79,6 +101,26 @@ _HANDOFFS = _REG.counter(
     "Journaled jobs re-submitted to a healthy replica after their "
     "owner died (death) or began draining (drain)",
     ("reason",),
+)
+_HTTP_SECONDS = _REG.histogram(
+    "fleet_http_seconds",
+    "Router front-door HTTP latency per route and status code — "
+    "measured in middleware, so front-door cost is separable from "
+    "replica latency",
+    ("route", "code"),
+)
+_PROXY_ERRORS = _REG.counter(
+    "fleet_proxy_errors_total",
+    "Proxied replica requests that failed at the router (unreachable "
+    "replica, bad body), per route",
+    ("route",),
+)
+_ANOMALIES = _REG.counter(
+    "fleet_anomalies_total",
+    "Fleet-anomaly episodes: a replica's p95 or burn rate exceeded the "
+    "fleet median by DG16_FLEET_ANOMALY_FACTOR (each also writes a "
+    "flight-recorder dump, trigger fleet_anomaly)",
+    ("replica", "signal"),
 )
 
 
@@ -122,6 +164,9 @@ class RoutedJob:
     priority: str
     circuit_id: str
     kind: str
+    # end-to-end trace id, minted next to the job id and propagated to
+    # the replica in X-DG16-Trace (docs/OBSERVABILITY.md)
+    trace_id: str = ""
     state: str = "PENDING"
     replica: Replica | None = None
     created_at: float = field(default_factory=time.time)
@@ -129,16 +174,38 @@ class RoutedJob:
     charged: bool = True  # holds a tenant in-flight slot until terminal
     cancelled: bool = False  # DELETE before dispatch: dispatcher skips
     error: dict | None = None  # router-side terminal failure, if any
+    # router-side spans of this job's life at the front door (Chrome
+    # trace-event dicts on the router's perf_counter clock): admission,
+    # each queue wait, each dispatch attempt, handoff — the router tier
+    # of the stitched GET /fleet/jobs/{id}/trace
+    spans: list = field(default_factory=list, repr=False)
+    queued_pc: float = 0.0  # perf_counter at the last enqueue
 
     @property
     def terminal(self) -> bool:
         return self.state in _TERMINAL
+
+    def record_span(self, name: str, t0: float, dur: float, **attrs) -> None:
+        if len(self.spans) >= MAX_JOB_SPANS:
+            return
+        self.spans.append(
+            {
+                "name": name,
+                "ph": "X",
+                "ts": round(t0 * 1e6, 1),
+                "dur": round(dur * 1e6, 1),
+                "pid": ROUTER_PID,
+                "tid": 0,
+                "args": attrs,
+            }
+        )
 
     def to_dict(self) -> dict:
         out = {
             "jobId": self.id,
             "tenant": self.tenant,
             "priority": self.priority,
+            "traceId": self.trace_id,
             "circuitId": self.circuit_id,
             "state": self.state,
             "replica": self.replica.name if self.replica else None,
@@ -163,6 +230,10 @@ class FleetRouter:
         )
         self.admission = TenantAdmission(tenant_cfg or TenantConfig.from_env())
         self.queue = WeightedFairQueue(self.cfg.weights)
+        self.federator = MetricsFederator()
+        # (replica, signal) pairs currently over the anomaly threshold —
+        # one flight dump per episode, re-armed on recovery
+        self._anomaly_latched: set[tuple[str, str]] = set()
         self.jobs: dict[str, RoutedJob] = {}
         self._payloads: dict[str, dict[str, bytes]] = {}  # pending only
         self._terminal_order: deque[str] = deque()
@@ -205,6 +276,15 @@ class FleetRouter:
         while True:
             try:
                 await self.poll_once()
+                self.federator.retain(
+                    {
+                        r.name
+                        for r in self.registry.replicas
+                        if r.state != EJECTED
+                    }
+                )
+                self.federator.tick()
+                self._anomaly_pass()
                 await self._handoff_pass()
                 await self._sweep_jobs()
             except asyncio.CancelledError:
@@ -219,25 +299,130 @@ class FleetRouter:
             *(self._poll_replica(r) for r in self.registry.pollable())
         )
 
+    def _note_replica_failure(self, replica: Replica, op: str) -> None:
+        """Feed the ejection breaker; an ejection is a fleet-tier fault
+        the flight recorder must witness (docs/OBSERVABILITY.md)."""
+        if self.registry.note_failure(replica):
+            log.warning("replica %s ejected (%s)", replica.name, op)
+            _flight.note(
+                "replica_ejected", replica=replica.name, op=op
+            )
+            _flight.dump_soon(
+                "replica_ejected",
+                extra={"replica": replica.name, "op": op},
+            )
+
     async def _poll_replica(self, replica: Replica) -> None:
+        # the poll doubles as a clock-echo round (NTP-style, the PR 4
+        # heartbeat shape): t0/t3 on the router's perf_counter_ns, t1/t2
+        # echoed by the replica — feeding the per-replica ClockSync that
+        # rebases its trace events in the stitched fleet trace
+        t0 = _now_ns()
         try:
             async with self._session.get(
                 f"{replica.url}/readyz",
+                params={"echo": str(t0)},
                 timeout=aiohttp.ClientTimeout(total=max(1.0, self.cfg.poll_s)),
             ) as resp:
                 doc = await resp.json()
+            t3 = _now_ns()
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
             log.debug("poll %s failed: %r", replica.name, e)
-            self.registry.note_failure(replica)
+            self._note_replica_failure(replica, "poll")
             return
         # 503 + draining body is an ANSWER (deliberate drain), any other
         # non-200 is a failure
         if resp.status == 200 or doc.get("draining"):
+            echo = doc.get("clockEcho") or {}
+            try:
+                t1, t2 = int(echo["t1"]), int(echo["t2"])
+            except (KeyError, TypeError, ValueError):
+                pass  # pre-echo replica: stitching falls back to offset 0
+            else:
+                replica.clock.add_sample(*ClockSync.from_echo(t0, t1, t2, t3))
             self.registry.note_doc(replica, doc)
             if self._wake is not None:
-                self._wake.set()  # capacity may have appeared
+                # capacity may have appeared — wake the dispatcher
+                # BEFORE the federation scrape, so a slow /metrics
+                # cannot delay queued jobs that already have a home
+                self._wake.set()
+            await self._scrape_replica(replica)
         else:
-            self.registry.note_failure(replica)
+            self._note_replica_failure(replica, "poll")
+
+    async def _scrape_replica(self, replica: Replica) -> None:
+        """Federation scrape, same tick as the capacity poll. A failed
+        scrape never feeds the ejection breaker — /readyz just answered,
+        so the replica is alive; only the fleet view goes stale."""
+        try:
+            async with self._session.get(
+                f"{replica.url}/metrics",
+                timeout=aiohttp.ClientTimeout(total=max(1.0, self.cfg.poll_s)),
+            ) as resp:
+                if resp.status != 200:
+                    self.federator.note_failure(replica.name)
+                    return
+                text = await resp.text()
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            self.federator.note_failure(replica.name)
+            return
+        self.federator.note_scrape(replica.name, text)
+
+    # -- fleet anomaly hook ----------------------------------------------------
+
+    def _anomaly_pass(self) -> None:
+        """Flag replicas whose federated p95 or SLO burn deviates from
+        the fleet median beyond the knob'd factor: one counter increment
+        and one flight-recorder post-mortem per episode (latched until
+        the signal recovers). Needs >= 3 replicas with data — a median
+        of two is just the other replica."""
+        factor = self.cfg.anomaly_factor
+        if factor <= 0:
+            return
+        self._check_anomaly("p95_seconds", self.federator.replica_p95(), factor)
+        self._check_anomaly("burn_rate", self.federator.replica_burn(), factor)
+
+    def _check_anomaly(self, signal: str, values: dict, factor: float) -> None:
+        # a replica that stopped reporting this signal (ejected, or a
+        # restart reset it below the sample floor) re-arms: its next
+        # episode after rejoining must dump again, not hit a stale latch
+        self._anomaly_latched -= {
+            (name, sig)
+            for name, sig in self._anomaly_latched
+            if sig == signal and name not in values
+        }
+        if len(values) < 3:
+            return
+        median = statistics.median(values.values())
+        if median <= 0:
+            return
+        for name, value in values.items():
+            key = (name, signal)
+            if value > median * factor:
+                if key in self._anomaly_latched:
+                    continue
+                self._anomaly_latched.add(key)
+                _ANOMALIES.labels(replica=name, signal=signal).inc()
+                log.warning(
+                    "fleet anomaly: replica %s %s=%.3f vs fleet median %.3f",
+                    name, signal, value, median,
+                )
+                _flight.note(
+                    "fleet_anomaly", replica=name, signal=signal,
+                    value=value, median=median,
+                )
+                _flight.dump_soon(
+                    "fleet_anomaly",
+                    extra={
+                        "replica": name,
+                        "signal": signal,
+                        "value": value,
+                        "fleetMedian": median,
+                        "factor": factor,
+                    },
+                )
+            else:
+                self._anomaly_latched.discard(key)
 
     # -- handoff --------------------------------------------------------------
 
@@ -262,6 +447,7 @@ class FleetRouter:
         # read error (shared-journal mount hiccup) must leave the
         # handoff retryable on the next discovery pass, not strand the
         # dead replica's accepted jobs forever.
+        t_read0 = time.perf_counter()
         entries = await asyncio.to_thread(read_journal, replica.journal_dir)
         replica.handoff_done = True
         moved = 0
@@ -284,6 +470,9 @@ class FleetRouter:
                 priority=e.priority or DEFAULT_PRIORITY,
                 circuit_id=e.circuit_id,
                 kind=e.kind,
+                # the journaled trace id survives the handoff: the
+                # re-proved job stitches into the SAME end-to-end trace
+                trace_id=e.trace_id or uuid.uuid4().hex,
                 created_at=e.created_at,
                 # jobs the router never admitted (posted straight to the
                 # replica) are grandfathered: no tenant slot to release
@@ -292,6 +481,13 @@ class FleetRouter:
             job.state = "PENDING"
             job.replica = None
             self.jobs[job.id] = job
+            job.record_span(
+                "fleet.handoff",
+                t_read0,
+                time.perf_counter() - t_read0,
+                source=replica.name,
+                reason=reason,
+            )
             # rebuild the full submission: the journal keeps the payload
             # fields (witness/input bytes) and the rest of the identity
             # as record columns. The re-queued payloads live in router
@@ -305,7 +501,7 @@ class FleetRouter:
             if e.kind == "mpc_prove":
                 fields["mpc"] = b"1"
             self._payloads[job.id] = fields
-            self.queue.push(job.tenant, job.priority, job)
+            self._queue_job(job)
             _HANDOFFS.labels(reason=reason).inc()
             self.handoffs += 1
             moved += 1
@@ -313,6 +509,18 @@ class FleetRouter:
             log.info(
                 "handoff: re-queued %d journaled job(s) from %s (%s)",
                 moved, replica.name, reason,
+            )
+            _flight.note(
+                "fleet_handoff", replica=replica.name, reason=reason,
+                moved=moved,
+            )
+            _flight.dump_soon(
+                "fleet_handoff",
+                extra={
+                    "replica": replica.name,
+                    "reason": reason,
+                    "moved": moved,
+                },
             )
             if self._wake is not None:
                 self._wake.set()
@@ -368,12 +576,25 @@ class FleetRouter:
 
     # -- dispatch -------------------------------------------------------------
 
+    def _queue_job(self, job: RoutedJob) -> None:
+        """Every enqueue goes through here so the queue-wait span always
+        has its start stamp."""
+        job.queued_pc = time.perf_counter()
+        self.queue.push(job.tenant, job.priority, job)
+
     async def _dispatch_loop(self) -> None:
         while True:
             job = self.queue.pop()
             if job is None:
                 await self._wait_for_work()
                 continue
+            if job.queued_pc:
+                now = time.perf_counter()
+                job.record_span(
+                    "fleet.queue", job.queued_pc, now - job.queued_pc,
+                    priority=job.priority,
+                )
+                job.queued_pc = 0.0
             if job.cancelled:
                 self._note_state(job, "CANCELLED")
                 continue
@@ -382,7 +603,7 @@ class FleetRouter:
                 # no replica could take it right now: back of its own
                 # tenant line, then wait for capacity (a poll refreshes
                 # scores and sets the wake event)
-                self.queue.push(job.tenant, job.priority, job)
+                self._queue_job(job)
                 await self._wait_for_work()
 
     async def _wait_for_work(self) -> None:
@@ -408,6 +629,19 @@ class FleetRouter:
                     # poison pill forever
                     self._payloads.pop(job.id, None)
                     self._note_state(job, "FAILED")
+                    _flight.note(
+                        "fleet_dispatch_failed", job=job.id,
+                        attempts=job.attempts,
+                        error=(job.error or {}).get("message"),
+                    )
+                    _flight.dump_soon(
+                        "fleet_dispatch_failed",
+                        extra={
+                            "job": job.id,
+                            "attempts": job.attempts,
+                            "error": job.error,
+                        },
+                    )
                     return True
                 return False
             tried.add(replica.url)
@@ -438,6 +672,17 @@ class FleetRouter:
         return best
 
     async def _submit_to(self, replica: Replica, job: RoutedJob) -> str:
+        """One dispatch attempt, recorded as a fleet.dispatch span so the
+        stitched trace shows every replica the payload visited."""
+        t0 = time.perf_counter()
+        outcome = await self._submit_to_inner(replica, job)
+        job.record_span(
+            "fleet.dispatch", t0, time.perf_counter() - t0,
+            replica=replica.name, outcome=outcome,
+        )
+        return outcome
+
+    async def _submit_to_inner(self, replica: Replica, job: RoutedJob) -> str:
         fields = self._payloads.get(job.id)
         if fields is None:  # cancelled/handed off under us
             return "accepted"
@@ -452,6 +697,7 @@ class FleetRouter:
                 headers={
                     "X-DG16-Tenant": job.tenant,
                     "X-DG16-Priority": job.priority,
+                    "X-DG16-Trace": job.trace_id,
                 },
                 timeout=aiohttp.ClientTimeout(total=600.0),
             ) as resp:
@@ -459,7 +705,7 @@ class FleetRouter:
                 status = resp.status
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
             log.debug("dispatch %s -> %s failed: %r", job.id, replica.name, e)
-            self.registry.note_failure(replica)
+            self._note_replica_failure(replica, "dispatch")
             return "failed"
         if status in (200, 202):
             job.replica = replica
@@ -518,6 +764,7 @@ class FleetRouter:
     # -- HTTP handlers --------------------------------------------------------
 
     async def jobs_prove(self, request):
+        t_req0 = time.perf_counter()
         tenant = request.headers.get("X-DG16-Tenant", "").strip() \
             or DEFAULT_TENANT
         try:
@@ -565,15 +812,24 @@ class FleetRouter:
             priority=priority,
             circuit_id=circuit_id,
             kind="mpc_prove" if mpc else "prove",
+            # the end-to-end trace context is born here, next to the
+            # idempotent job id: every router span, replica service
+            # span, and MPC-party span downstream carries it
+            trace_id=uuid.uuid4().hex,
+        )
+        job.record_span(
+            "fleet.admission", t_req0, time.perf_counter() - t_req0,
+            tenant=tenant, priority=priority,
         )
         self.jobs[job.id] = job
         self._payloads[job.id] = fields
-        self.queue.push(tenant, priority, job)
+        self._queue_job(job)
         if self._wake is not None:
             self._wake.set()
         return web.json_response(
             {
                 "jobId": job.id,
+                "traceId": job.trace_id,
                 "tenant": tenant,
                 "priority": priority,
                 "state": job.state,
@@ -592,7 +848,10 @@ class FleetRouter:
         job = self._job_or_404(request)
         if isinstance(job, web.Response):
             return job
-        if job.replica is None:
+        # snapshot the owner: a concurrent handoff may null job.replica
+        # while the proxy await is in flight
+        replica = job.replica
+        if replica is None:
             if suffix:
                 if job.state == "FAILED":
                     return _error(
@@ -607,16 +866,17 @@ class FleetRouter:
         try:
             async with self._session.request(
                 request.method,
-                f"{job.replica.url}/jobs/{job.id}{suffix}",
+                f"{replica.url}/jobs/{job.id}{suffix}",
                 timeout=aiohttp.ClientTimeout(total=60.0),
             ) as resp:
                 body = await resp.read()
                 status = resp.status
                 ctype = resp.content_type
         except (aiohttp.ClientError, asyncio.TimeoutError):
-            self.registry.note_failure(job.replica)
+            _PROXY_ERRORS.labels(route=f"/jobs/{{job_id}}{suffix}").inc()
+            self._note_replica_failure(replica, "proxy")
             return _error(
-                f"replica {job.replica.name} unreachable "
+                f"replica {replica.name} unreachable "
                 "(handoff will re-route the job)",
                 status=503,
             )
@@ -638,6 +898,93 @@ class FleetRouter:
 
     async def job_trace(self, request):
         return await self._proxy_job(request, "/trace")
+
+    async def fleet_job_trace(self, request):
+        """GET /fleet/jobs/{id}/trace — the STITCHED end-to-end trace:
+        router-tier spans (admission, queue wait, dispatch attempts,
+        handoff) plus the owning replica's merged job trace — service
+        phases and MPC-party rounds — rebased onto the router's clock
+        via the /readyz poll echoes, one Chrome trace out. Clicking any
+        job shows the full router -> queue -> batch -> MPC-round
+        critical path across all three tiers (docs/OBSERVABILITY.md
+        "Fleet observatory")."""
+        job = self.jobs.get(request.match_info["job_id"])
+        if job is None:
+            return _error("unknown job id", status=404)
+        events = [dict(ev) for ev in job.spans]
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": ROUTER_PID,
+                "args": {"name": "fleet router"},
+            }
+        ]
+        warning = None
+        # snapshot the owner: a concurrent handoff may null job.replica
+        # while the trace fetch await is in flight
+        replica = job.replica
+        if replica is not None:
+            body = None
+            try:
+                async with self._session.get(
+                    f"{replica.url}/jobs/{job.id}/trace",
+                    timeout=aiohttp.ClientTimeout(total=60.0),
+                ) as resp:
+                    if resp.status == 200:
+                        body = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+                body = None
+            if body is None:
+                warning = (
+                    f"replica {replica.name} did not serve the job "
+                    "trace; router spans only"
+                )
+                _PROXY_ERRORS.labels(
+                    route="/fleet/jobs/{job_id}/trace"
+                ).inc()
+            else:
+                # rebase replica perf_counter timestamps onto the
+                # router's clock: ClockSync.offset_ns estimates
+                # replica_clock − router_clock, so ADD its negation
+                # (the PR 4 add_party convention)
+                off_us = -replica.clock.offset_ns / 1e3
+                pids: set[int] = set()
+                for ev in body.get("traceEvents", []):
+                    if not isinstance(ev, dict):
+                        continue
+                    ts = ev.get("ts")
+                    if not isinstance(ts, (int, float)):
+                        continue  # metadata/corrupt events don't rebase
+                    ev = dict(ev)
+                    ev["ts"] = ts + off_us
+                    try:
+                        pids.add(int(ev.get("pid", 0)))
+                    except (TypeError, ValueError):
+                        ev["pid"] = 0
+                        pids.add(0)
+                    events.append(ev)
+                for p in sorted(pids):
+                    name = f"replica {replica.name}"
+                    if p != 0:
+                        name += f" party {p}"
+                    meta.append(
+                        {
+                            "name": "process_name",
+                            "ph": "M",
+                            "pid": p,
+                            "args": {"name": name},
+                        }
+                    )
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        out = {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "traceId": job.trace_id,
+        }
+        if warning is not None:
+            out["warning"] = warning
+        return web.json_response(out)
 
     async def job_cancel(self, request):
         job = self._job_or_404(request)
@@ -664,7 +1011,24 @@ class FleetRouter:
                 "weights": dict(self.cfg.weights),
                 "handoffs": self.handoffs,
                 "jobsTracked": len(self.jobs),
+                "federation": {
+                    "replicasScraped": len(self.federator.replicas()),
+                    "scrapesOk": self.federator.scrapes_ok,
+                    "scrapesFailed": self.federator.scrapes_failed,
+                    "seriesSkipped": self.federator.series_skipped,
+                },
             }
+        )
+
+    async def fleet_metrics(self, request):
+        """GET /fleet/metrics — the federated exposition: every live
+        replica's series re-exported with a `replica` label plus the
+        fleet rollups (docs/OBSERVABILITY.md "Fleet observatory"). The
+        router's own families stay on /metrics."""
+        return web.Response(
+            text=self.federator.render(),
+            content_type="text/plain",
+            charset="utf-8",
         )
 
     async def fleet_drain(self, request):
@@ -681,6 +1045,7 @@ class FleetRouter:
             ) as resp:
                 ok = resp.status == 200
         except (aiohttp.ClientError, asyncio.TimeoutError):
+            _PROXY_ERRORS.labels(route="/fleet/drain/{replica}").inc()
             ok = False
         if not ok and replica.state != EJECTED:
             return _error(
@@ -725,8 +1090,38 @@ class FleetRouter:
 
     # -- app ------------------------------------------------------------------
 
+    @web.middleware
+    async def _http_middleware(self, request, handler):
+        """Front-door latency histogram per (route template, status):
+        the router's own cost, separable from replica latency. The label
+        is the matched ROUTE (bounded cardinality — unmatched paths all
+        land on "unmatched"), never the raw path."""
+        t0 = time.perf_counter()
+        code = 500
+        try:
+            resp = await handler(request)
+            code = resp.status
+            return resp
+        except web.HTTPException as e:
+            code = e.status
+            raise
+        finally:
+            resource = (
+                request.match_info.route.resource
+                if request.match_info.route is not None
+                else None
+            )
+            route = (
+                resource.canonical if resource is not None else "unmatched"
+            )
+            _HTTP_SECONDS.labels(route=route, code=str(code)).observe(
+                time.perf_counter() - t0
+            )
+
     def app(self) -> web.Application:
-        app = web.Application(client_max_size=MAX_BODY)
+        app = web.Application(
+            client_max_size=MAX_BODY, middlewares=[self._http_middleware]
+        )
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         app.router.add_post("/jobs/prove", self.jobs_prove)
@@ -735,6 +1130,10 @@ class FleetRouter:
         app.router.add_get("/jobs/{job_id}/trace", self.job_trace)
         app.router.add_delete("/jobs/{job_id}", self.job_cancel)
         app.router.add_get("/fleet/stats", self.fleet_stats)
+        app.router.add_get("/fleet/metrics", self.fleet_metrics)
+        app.router.add_get(
+            "/fleet/jobs/{job_id}/trace", self.fleet_job_trace
+        )
         # {replica:.+}: the operand may be the config URL itself
         # (slashes and all) — `find` accepts either spelling
         app.router.add_post("/fleet/drain/{replica:.+}", self.fleet_drain)

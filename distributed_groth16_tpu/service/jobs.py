@@ -98,6 +98,13 @@ class ProofJob:
     # so a handoff re-routes under the right tenant.
     tenant: str = ""
     priority: str = ""
+    # end-to-end trace context (docs/OBSERVABILITY.md "Fleet
+    # observatory"): minted by the fleet router next to the idempotent
+    # job id and propagated via the X-DG16-Trace header, or minted at
+    # the replica door for direct submissions. Rides the DTO and the
+    # journal so a handoff re-proves under the SAME trace, and the
+    # stitched fleet trace can join router spans to replica spans.
+    trace_id: str = ""
     id: str = field(default_factory=lambda: uuid.uuid4().hex)
     state: JobState = JobState.QUEUED
     created_at: float = field(default_factory=time.time)
@@ -202,7 +209,7 @@ class ProofJob:
         self._dropped_spans = self.trace.dropped
         events = self.trace.events()
         self._spans_json = json.dumps(self.trace.span_tree())
-        self._chrome_json = json.dumps(chrome_envelope(events))
+        self._chrome_json = json.dumps(self._envelope(events))
         if events:
             # window the decomposition to the MPC round: the harness
             # spans ("job", the load/witness/packing phases) are pid-0
@@ -241,12 +248,21 @@ class ProofJob:
         await self._done.wait()
         return self
 
+    def _envelope(self, events: list) -> dict:
+        """The job's Chrome trace object, stamped with the trace id so a
+        downloaded file still says which end-to-end trace it belongs to
+        (viewers ignore the extra key)."""
+        env = chrome_envelope(events)
+        if self.trace_id:
+            env["traceId"] = self.trace_id
+        return env
+
     def chrome_trace_json(self) -> str:
         """The job's Chrome trace-event JSON (GET /jobs/{id}/trace):
         the compacted snapshot once terminal, the live buffer before."""
         if self._chrome_json is not None:
             return self._chrome_json
-        return json.dumps(self.trace.chrome_trace())
+        return json.dumps(self._envelope(self.trace.events()))
 
     @property
     def runtime_s(self) -> float | None:
@@ -262,6 +278,7 @@ class ProofJob:
             "circuitId": self.circuit_id,
             "tenant": self.tenant,
             "priority": self.priority,
+            "traceId": self.trace_id,
             "state": self.state.value,
             "createdAt": self.created_at,
             "startedAt": self.started_at,

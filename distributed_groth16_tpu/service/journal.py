@@ -31,7 +31,7 @@ throwaway replicas).
 Record grammar (one JSON object per line):
 
   {"k": "submit", "id", "kind", "cid", "l", "t", "fields": {name: b64},
-   ["tenant", "priority"]}
+   ["tenant", "priority", "trace"]}
   {"k": "state",  "id", "state", "t", ["error": {type,message,phase}]}
   {"k": "quarantine", "id", "t", "reason"}
   {"k": "checkpoint", "t"}          # clean-shutdown marker
@@ -99,6 +99,8 @@ def _submit_record(e: "JournalEntry") -> dict:
         rec["tenant"] = e.tenant
     if e.priority:
         rec["priority"] = e.priority
+    if e.trace_id:
+        rec["trace"] = e.trace_id
     return rec
 
 _TERMINAL = {JobState.DONE.value, JobState.FAILED.value, JobState.CANCELLED.value}
@@ -117,9 +119,12 @@ class JournalEntry:
     state: str = JobState.QUEUED.value
     quarantined: bool = False
     # fleet metadata (docs/FLEET.md): a handoff must re-route the job
-    # under the tenant that submitted it, so identity rides the WAL
+    # under the tenant that submitted it, so identity rides the WAL —
+    # and under the same end-to-end trace id, so the re-proved job's
+    # spans still stitch into the trace the router minted
     tenant: str = ""
     priority: str = ""
+    trace_id: str = ""
 
     @property
     def replayable(self) -> bool:
@@ -162,6 +167,7 @@ def _apply_record(
             fields=_decode_fields(rec.get("fields", {})),
             tenant=rec.get("tenant", ""),
             priority=rec.get("priority", ""),
+            trace_id=rec.get("trace", ""),
         )
     elif k == "state":
         e = live.get(rec.get("id"))
@@ -316,6 +322,7 @@ class JobJournal:
                     fields=dict(job.fields),
                     tenant=getattr(job, "tenant", ""),
                     priority=getattr(job, "priority", ""),
+                    trace_id=getattr(job, "trace_id", ""),
                 )
                 self._live[job.id] = e
                 ripe = self._append(_submit_record(e), "submit")

@@ -112,9 +112,14 @@ class ProofExecutor:
         buffer (GET /jobs/{id} metrics block — and DG16_TRACE_OUT, if
         set), and any transport failure inside the MPC round carries the
         job id (net.job_context -> MpcNetError.job_id)."""
+        attrs = {"kind": job.kind, "circuit": job.circuit_id}
+        if job.trace_id:
+            # the cross-tier trace context (docs/OBSERVABILITY.md "Fleet
+            # observatory"): every span nested under the job root joins
+            # the router-minted trace via this attribute
+            attrs["trace"] = job.trace_id
         with tracing.collect(job.trace), job_context(job.id), tracing.span(
-            "job", job=job.id, attrs={"kind": job.kind,
-                                      "circuit": job.circuit_id},
+            "job", job=job.id, attrs=attrs,
         ):
             return self._run(job)
 

@@ -27,6 +27,7 @@ default because it is allocation-free).
 
 from __future__ import annotations
 
+import re
 import threading
 from bisect import bisect_left
 from typing import Sequence
@@ -388,3 +389,184 @@ _REGISTRY = MetricsRegistry()
 def registry() -> MetricsRegistry:
     """The process-wide registry every layer records into."""
     return _REGISTRY
+
+
+# -- exposition parsing + snapshot merging (the federation utilities) ---------
+#
+# The fleet router (fleet/federate.py) scrapes every replica's /metrics,
+# re-exports the series with a `replica` label, and rolls the fleet up
+# (merged job_seconds histograms -> fleet p50/p95). That needs the read
+# side of the text format this module writes: a parser back into
+# (family, samples), and histogram snapshot math — cumulative bucket
+# counts are summable across shards (sum of cumulatives = cumulative of
+# sums), which is what makes federated quantiles possible at all.
+
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) ?(.*)$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+    r"(counter|gauge|histogram|summary|untyped)$"
+)
+# value, then an OPTIONAL int64 millisecond timestamp — spec-legal in
+# 0.0.4 (exporters/sidecars append it); parsed but discarded, since the
+# federation treats every scrape as "now"
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)(?: (-?\d+))?$"
+)
+_LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+_UNESCAPE_RE = re.compile(r"\\(.)")
+_UNESCAPE_MAP = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def _unescape_label(v: str) -> str:
+    # single pass, never sequential str.replace: unescaping "\\n"
+    # (backslash then literal n) with replace("\\n", "\n") first would
+    # corrupt it into a real newline
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPE_MAP.get(m.group(1), m.group(0)), v
+    )
+
+
+class ParsedFamily:
+    """One metric family read back from text exposition: `samples` is a
+    list of (sample_name, labels_dict, value) — sample names keep their
+    `_bucket`/`_sum`/`_count` suffixes so histogram math stays explicit."""
+
+    __slots__ = ("name", "kind", "help", "samples")
+
+    def __init__(self, name: str, kind: str, help: str = "", samples=None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.samples: list[tuple[str, dict, float]] = samples or []
+
+
+def _parse_value(raw: str) -> float:
+    if raw in ("Inf", "+Inf"):
+        return INF
+    if raw == "-Inf":
+        return -INF
+    return float(raw)
+
+
+def parse_exposition(text: str) -> dict[str, ParsedFamily]:
+    """Parse Prometheus text format 0.0.4 into {family_name: ParsedFamily}.
+    Sample lines are attributed to their base family (stripping the
+    histogram suffixes); a malformed line raises ValueError — a federated
+    scrape must fail loudly, not silently drop half a replica's series."""
+    fams: dict[str, ParsedFamily] = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            if m:
+                name, kind = m.group(1), m.group(2)
+                fam = fams.get(name)
+                if fam is None:
+                    fams[name] = ParsedFamily(name, kind)
+                else:
+                    fam.kind = kind
+                continue
+            m = _HELP_RE.match(line)
+            if m:
+                name = m.group(1)
+                fam = fams.setdefault(name, ParsedFamily(name, "untyped"))
+                fam.help = m.group(2)
+                continue
+            continue  # other comments are legal and ignored
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        sname, raw_labels, raw_value = m.group(1), m.group(2), m.group(3)
+        labels: dict[str, str] = {}
+        if raw_labels:
+            pairs = _LABEL_PAIR_RE.findall(raw_labels)
+            if _LABEL_PAIR_RE.sub("", raw_labels).strip(',"'):
+                raise ValueError(f"bad label syntax: {line!r}")
+            labels = {k: _unescape_label(v) for k, v in pairs}
+        base = sname
+        if base not in fams:
+            for suffix in ("_bucket", "_sum", "_count"):
+                if sname.endswith(suffix) and sname[: -len(suffix)] in fams:
+                    base = sname[: -len(suffix)]
+                    break
+        fam = fams.setdefault(base, ParsedFamily(base, "untyped"))
+        fam.samples.append((sname, labels, _parse_value(raw_value)))
+    return fams
+
+
+class HistogramSnapshot:
+    """One histogram's state as read from exposition: sorted bucket
+    bounds, CUMULATIVE counts aligned to them, and the _sum/_count pair.
+    Snapshots with identical bounds merge by plain addition — that is
+    the whole federation trick."""
+
+    __slots__ = ("bounds", "cumulative", "sum", "count")
+
+    def __init__(self, bounds, cumulative, sum, count):
+        self.bounds = tuple(bounds)
+        self.cumulative = list(cumulative)
+        self.sum = float(sum)
+        self.count = float(count)
+
+
+def histogram_snapshots(
+    family: ParsedFamily, group_by: tuple = ()
+) -> dict[tuple, HistogramSnapshot]:
+    """The snapshot-merge utility: fold a parsed histogram family's
+    series into one HistogramSnapshot per combination of the `group_by`
+    label values, merging every OTHER label dimension away. Examples:
+    `group_by=("kind",)` merges a replica-labeled federated `job_seconds`
+    into per-kind fleet histograms; `group_by=("replica",)` merges kinds
+    into per-replica latency; `()` merges everything into one."""
+    acc: dict[tuple, dict] = {}
+    for sname, labels, value in family.samples:
+        key = tuple(labels.get(g, "") for g in group_by)
+        slot = acc.setdefault(key, {"les": {}, "sum": 0.0, "count": 0.0})
+        if sname.endswith("_bucket"):
+            le = labels.get("le")
+            if le is None:
+                continue
+            b = _parse_value(le)
+            slot["les"][b] = slot["les"].get(b, 0.0) + value
+        elif sname.endswith("_sum"):
+            slot["sum"] += value
+        elif sname.endswith("_count"):
+            slot["count"] += value
+    out: dict[tuple, HistogramSnapshot] = {}
+    for key, slot in acc.items():
+        bounds = tuple(sorted(slot["les"]))
+        out[key] = HistogramSnapshot(
+            bounds=bounds,
+            cumulative=[slot["les"][b] for b in bounds],
+            sum=slot["sum"],
+            count=slot["count"],
+        )
+    return out
+
+
+def histogram_quantile(snap: HistogramSnapshot, q: float) -> float:
+    """Prometheus-style bucket quantile: linear interpolation inside the
+    bucket the target rank lands in; ranks in the +Inf bucket answer the
+    highest finite bound (the honest cap of what buckets can say).
+    Returns 0.0 for an empty snapshot."""
+    if snap.count <= 0 or not snap.bounds:
+        return 0.0
+    target = q * snap.count
+    prev_bound = 0.0
+    prev_cum = 0.0
+    for bound, cum in zip(snap.bounds, snap.cumulative):
+        if cum >= target:
+            if bound == INF:
+                return prev_bound
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return bound
+            frac = (target - prev_cum) / in_bucket
+            return prev_bound + (bound - prev_bound) * frac
+        prev_cum = cum
+        if bound != INF:
+            prev_bound = bound
+    return prev_bound

@@ -69,6 +69,7 @@ KNOBS: dict[str, str] = {
     "DG16_FLEET_WEIGHTS": "priority-class weights, class=weight CSV",
     "DG16_FLEET_REPLICA_ID": "this replica's id in /readyz (default: random)",
     "DG16_FLEET_HISTORY": "terminal routed jobs the router keeps addressable",
+    "DG16_FLEET_ANOMALY_FACTOR": "replica p95/burn vs fleet-median anomaly factor, <=0 off",
     # tenant admission (docs/FLEET.md)
     "DG16_TENANT_RATE": "default tenant token-bucket refill, jobs/sec, <=0 off",
     "DG16_TENANT_BURST": "default tenant token-bucket capacity",
@@ -389,6 +390,11 @@ class FleetConfig:
         dispatch at weight 1.
       * history — terminal routed jobs kept addressable through the
         router (same eviction contract as DG16_SERVICE_JOB_HISTORY).
+      * anomaly_factor — fleet-anomaly hook (docs/OBSERVABILITY.md
+        "Fleet observatory"): a replica whose federated job p95 or SLO
+        burn rate exceeds the fleet MEDIAN by this factor gets one
+        flight-recorder post-mortem per episode (trigger fleet_anomaly).
+        <= 0 disables the hook.
     """
 
     replicas: tuple = ()
@@ -398,6 +404,7 @@ class FleetConfig:
     pending_bound: int = 256
     weights: tuple = (("interactive", 8), ("batch", 3), ("bulk", 1))
     history: int = 4096
+    anomaly_factor: float = 3.0
 
     def weight_for(self, priority: str) -> int:
         for k, v in self.weights:
@@ -457,6 +464,7 @@ class FleetConfig:
                 else FleetConfig.weights
             ),
             history=env_int("DG16_FLEET_HISTORY", 4096),
+            anomaly_factor=env_float("DG16_FLEET_ANOMALY_FACTOR", 3.0),
         )
 
 

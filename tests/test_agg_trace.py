@@ -662,5 +662,7 @@ def test_cli_trace_subcommand_writes_file(tmp_path, monkeypatch):
         argparse.Namespace(url="http://x", job_id="abc123", out=out)
     )
     assert seen["url"] == "http://x/jobs/abc123/trace"
-    assert res == {"jobId": "abc123", "out": out, "events": 1}
+    assert res == {
+        "jobId": "abc123", "source": "http://x", "out": out, "events": 1
+    }
     assert json.loads(open(out).read())["traceEvents"]

@@ -13,17 +13,21 @@ idempotent submission by job id, and the CLI fleet table.
 """
 
 import asyncio
+import json
+import os
 import threading
 import time
 
 import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
+from test_telemetry import parse_prometheus
 
 from distributed_groth16_tpu.api.server import ApiServer
 from distributed_groth16_tpu.api.store import CircuitStore
 from distributed_groth16_tpu.fleet import (
     FleetRouter,
+    MetricsFederator,
     ReplicaRegistry,
     TenantAdmission,
     TenantQuotaError,
@@ -31,6 +35,9 @@ from distributed_groth16_tpu.fleet import (
     WeightedFairQueue,
 )
 from distributed_groth16_tpu.fleet.registry import ACTIVE, DRAINING, EJECTED
+from distributed_groth16_tpu.fleet.router import ROUTER_PID
+from distributed_groth16_tpu.service.journal import read_journal
+from distributed_groth16_tpu.telemetry.metrics import MetricsRegistry
 from distributed_groth16_tpu.frontend.ark_serde import proof_from_bytes
 from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
 from distributed_groth16_tpu.frontend.readers import write_r1cs, write_wtns
@@ -124,6 +131,11 @@ async def _poll_terminal(client, job_id: str) -> dict:
     while time.monotonic() < deadline:
         resp = await client.get(f"/jobs/{job_id}")
         body = await resp.json()
+        if resp.status == 503:
+            # the documented mid-outage answer ("replica unreachable,
+            # handoff will re-route the job"): transient, poll on
+            await asyncio.sleep(0.1)
+            continue
         assert resp.status == 200, body
         if body["state"] in ("DONE", "FAILED", "CANCELLED"):
             return body
@@ -204,6 +216,21 @@ def test_fleet_kill_replica_mid_flight_loses_no_accepted_job(
                     accepted.append(body["jobId"])
             assert len(accepted) == 26
 
+            # one MPC job alongside them: the acceptance trace must show
+            # all THREE tiers (router / replica service / MPC parties),
+            # which a single-prover job cannot (tracked separately — its
+            # proof blob differs from the sequential path's)
+            resp = await client.post(
+                "/jobs/prove",
+                data={"circuit_id": cid, "witness_file": wtns,
+                      "mpc": "1", "l": "2"},
+                headers={"X-DG16-Tenant": "t1"},
+            )
+            body = await resp.json()
+            assert resp.status == 202, body
+            mpc_jid, mpc_trace_id = body["jobId"], body["traceId"]
+            assert mpc_trace_id
+
             # wait until the doomed replica owns dispatched jobs (its
             # blocking executor guarantees they cannot finish there)
             doomed = router.registry.replicas[2]
@@ -227,6 +254,80 @@ def test_fleet_kill_replica_mid_flight_loses_no_accepted_job(
             for jid in accepted:
                 status = await _poll_terminal(client, jid)
                 assert status["state"] == "DONE", status
+            mpc_status = await _poll_terminal(client, mpc_jid)
+            assert mpc_status["state"] == "DONE", mpc_status
+            # the trace id propagated router -> replica -> DTO
+            assert mpc_status["traceId"] == mpc_trace_id
+
+            # the STITCHED end-to-end trace: one Chrome trace, all three
+            # tiers, one rebased clock (the acceptance criterion)
+            resp = await client.get(f"/fleet/jobs/{mpc_jid}/trace")
+            stitched = await resp.json()
+            assert resp.status == 200, stitched
+            # CI uploads the stitched trace next to the flight dumps on
+            # failure — write it BEFORE asserting on its contents, so a
+            # stitching regression leaves the artifact that debugs it
+            art_dir = os.environ.get("DG16_FLIGHT_ARTIFACT_DIR")
+            if art_dir:
+                os.makedirs(art_dir, exist_ok=True)
+                with open(
+                    os.path.join(art_dir, f"fleet-trace-{mpc_jid}.json"),
+                    "w",
+                ) as fh:
+                    json.dump(stitched, fh)
+            assert stitched["traceId"] == mpc_trace_id
+            evs = [e for e in stitched["traceEvents"]
+                   if e.get("ph", "X") == "X"]
+            names = {e["name"] for e in evs}
+            # tier 1: the router's own spans
+            router_evs = [e for e in evs if e.get("pid") == ROUTER_PID]
+            assert {"fleet.admission", "fleet.queue",
+                    "fleet.dispatch"} <= {e["name"] for e in router_evs}
+            # tier 2: replica service phases (pid 0 harness spans)
+            assert "job" in names and "load" in names
+            # tier 3: MPC-party rounds on their own tracks
+            party_pids = {int(e.get("pid", 0)) for e in evs} - {ROUTER_PID}
+            assert len(party_pids) > 1 and max(party_pids) >= 1
+            # one common clock: in-process tiers share the perf_counter
+            # epoch, so the rebased spans must all land inside the test's
+            # own lifetime window, not hours apart
+            spread_us = (
+                max(e["ts"] + e.get("dur", 0) for e in evs)
+                - min(e["ts"] for e in evs)
+            )
+            assert spread_us < 30 * 60 * 1e6, spread_us
+            # track metadata names every tier
+            meta_names = {
+                m["args"]["name"]
+                for m in stitched["traceEvents"]
+                if m.get("ph") == "M"
+            }
+            assert "fleet router" in meta_names
+            assert any(n.startswith("replica ") for n in meta_names)
+
+            # metrics federation: replica-labeled series + merged-
+            # histogram rollups through a STRICT 0.0.4 parser (the
+            # acceptance criterion's other half)
+            resp = await client.get("/fleet/metrics")
+            text = await resp.text()
+            assert resp.status == 200
+            types, samples = parse_prometheus(text)
+            assert types["job_seconds"] == "histogram"
+            scraped = {
+                dict(labels).get("replica")
+                for (name, labels) in samples
+                if name == "job_seconds_count"
+            }
+            assert {"r-a", "r-b"} <= scraped  # the ejected one dropped out
+            assert types["fleet_job_seconds"] == "histogram"
+            fleet_count = sum(
+                v for (name, labels), v in samples.items()
+                if name == "fleet_job_seconds_count"
+            )
+            assert fleet_count >= len(accepted)
+            assert samples[("fleet_replicas_scraped", ())] == 2.0
+            assert ("fleet_jobs_per_second", ()) in samples
+            assert types["fleet_job_quantile_seconds"] == "gauge"
 
             # zero lost, and the handoff actually moved work
             assert router.handoffs >= len(owned)
@@ -473,6 +574,22 @@ def test_readyz_capacity_document_and_admin_drain(circuit):
             assert doc["running"] == 0 and doc["queueBound"] == 64
             assert doc["maxBurnRate"] == 0.0
             assert doc["devices"] == 0 and doc["openBreakers"] == 0
+            # no echo param -> no clock block (capacity doc stays lean)
+            assert "clockEcho" not in doc
+
+            # the clock echo: ?echo=<t0> answers {t0 echoed, t1 receipt,
+            # t2 send} over perf_counter_ns — one NTP-style sample per
+            # poll for the router's per-replica ClockSync
+            resp = await client.get("/readyz", params={"echo": "12345"})
+            echo = (await resp.json())["clockEcho"]
+            assert echo["t0"] == 12345
+            assert isinstance(echo["t1"], int)
+            assert isinstance(echo["t2"], int)
+            assert echo["t1"] <= echo["t2"]
+            # a malformed echo is ignored, not a 500
+            resp = await client.get("/readyz", params={"echo": "bogus"})
+            assert resp.status == 200
+            assert "clockEcho" not in await resp.json()
 
             # /healthz body keeps its pre-fleet shape exactly
             resp = await client.get("/healthz")
@@ -619,3 +736,371 @@ def test_cli_fleet_table_formatting():
     assert "r-a" in lines[1] and "active" in lines[1]
     assert "r-b" in lines[2] and "ejected" in lines[2] and "-" in lines[2]
     assert "handoffs=5" in lines[-1] and "rejected=2" in lines[-1]
+
+
+# -- fleet observatory: trace-id propagation through handoff ------------------
+
+
+def test_trace_id_survives_journal_backed_handoff(circuit, tmp_path):
+    """The satellite guarantee: a job re-submitted from a dead replica's
+    journal keeps the router-minted trace_id — the WAL carries it, the
+    handoff re-dispatch sends it in X-DG16-Trace, and the re-proving
+    replica's DTO reports it."""
+    root, cid, wtns, _ = circuit
+
+    async def run():
+        doomed_exec = _BlockingExecutor()
+        jdirs = [tmp_path / "ja", tmp_path / "jb"]
+        doomed = await _start_replica(
+            root, jdirs[0], "r-x", workers=2, executor=doomed_exec
+        )
+        healthy = await _start_replica(root, jdirs[1], "r-y", workers=2)
+        router = FleetRouter(
+            FleetConfig(
+                replicas=(
+                    (doomed.url, str(jdirs[0])),
+                    (healthy.url, str(jdirs[1])),
+                ),
+                poll_s=0.2,
+                eject_threshold=2,
+                eject_cooldown_s=60.0,
+            )
+        )
+        client = TestClient(TestServer(router.app()))
+        await client.start_server()
+        try:
+            traces = {}
+            for _ in range(6):
+                resp = await client.post(
+                    "/jobs/prove",
+                    data={"circuit_id": cid, "witness_file": wtns},
+                )
+                body = await resp.json()
+                assert resp.status == 202, body
+                assert body["traceId"]
+                traces[body["jobId"]] = body["traceId"]
+
+            # wait until the doomed replica owns a dispatched job (its
+            # blocking executor guarantees it cannot finish there)
+            drep = router.registry.replicas[0]
+            victim = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and victim is None:
+                victim = next(
+                    (
+                        j for j in router.jobs.values()
+                        if j.replica is drep and not j.terminal
+                    ),
+                    None,
+                )
+                await asyncio.sleep(0.05)
+            assert victim is not None, "no job landed on the doomed replica"
+
+            # the journaled submit record carries the trace id
+            entry = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and entry is None:
+                entry = {
+                    e.id: e for e in read_journal(str(jdirs[0]))
+                }.get(victim.id)
+                await asyncio.sleep(0.05)
+            assert entry is not None
+            assert entry.trace_id == traces[victim.id]
+
+            # crash the owner: ejection -> handoff -> re-prove elsewhere
+            await doomed.kill_listener()
+            status = await _poll_terminal(client, victim.id)
+            assert status["state"] == "DONE", status
+            # the re-submitted job kept its ORIGINAL trace_id
+            assert status["traceId"] == traces[victim.id]
+            # and the router-side handoff span is in the stitched trace
+            resp = await client.get(f"/fleet/jobs/{victim.id}/trace")
+            stitched = await resp.json()
+            assert resp.status == 200, stitched
+            names = {
+                e.get("name") for e in stitched["traceEvents"]
+                if e.get("pid") == ROUTER_PID
+            }
+            assert "fleet.handoff" in names
+        finally:
+            doomed_exec.release.set()
+            await client.close()
+            await doomed.cleanup()
+            await healthy.cleanup()
+
+    asyncio.run(run())
+
+
+# -- router /metrics + front-door middleware ----------------------------------
+
+
+def test_router_metrics_route_and_http_middleware():
+    """The router's own /metrics (satellite): strict 0.0.4, the fleet_*
+    families present, and the middleware histogram keyed by ROUTE
+    template (bounded cardinality), with unmatched paths folded into
+    one label value."""
+
+    async def run():
+        router = FleetRouter(
+            FleetConfig(
+                replicas=(("http://127.0.0.1:1", None),), poll_s=30.0
+            )
+        )
+        client = TestClient(TestServer(router.app()))
+        await client.start_server()
+        try:
+            assert (await client.get("/healthz")).status == 200
+            assert (await client.get("/no/such/route")).status == 404
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            types, samples = parse_prometheus(await resp.text())
+            assert types["fleet_replica_state"] == "gauge"
+            assert types["fleet_http_seconds"] == "histogram"
+            assert types["fleet_proxy_errors_total"] == "counter"
+            assert types["fleet_anomalies_total"] == "counter"
+            routes = {
+                (dict(labels).get("route"), dict(labels).get("code"))
+                for (name, labels) in samples
+                if name == "fleet_http_seconds_count"
+            }
+            assert ("/healthz", "200") in routes
+            assert ("unmatched", "404") in routes
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+# -- metrics federation units -------------------------------------------------
+
+
+def _replica_exposition(
+    n_jobs, runtime=0.5, burn=0.0, breaker_open=False
+) -> str:
+    """Render a plausible replica /metrics body from a fresh registry."""
+    reg = MetricsRegistry()
+    h = reg.histogram("job_seconds", "x", ("kind",), buckets=(1.0, 10.0))
+    for _ in range(n_jobs):
+        h.labels(kind="prove").observe(runtime)
+    reg.counter("jobs_finished_total", "x", ("state",)).labels(
+        state="DONE"
+    ).inc(n_jobs)
+    if burn:
+        reg.gauge("slo_burn_rate", "x", ("kind",)).labels(
+            kind="prove"
+        ).set(burn)
+    reg.gauge("mesh_breaker_state", "x", ("slice",)).labels(
+        slice="4p0"
+    ).set(2 if breaker_open else 0)
+    return reg.render_prometheus()
+
+
+def test_metrics_federator_replica_labels_and_rollups():
+    clk = _Clock()
+    fed = MetricsFederator(clock=clk)
+    fed.note_scrape("r-a", _replica_exposition(4))
+    fed.note_scrape("r-b", _replica_exposition(6, runtime=5.0, burn=1.5,
+                                               breaker_open=True))
+    fed.tick()
+    clk.t += 2.0
+    fed.note_scrape("r-b", _replica_exposition(10, runtime=5.0, burn=1.5,
+                                               breaker_open=True))
+    fed.tick()
+
+    types, samples = parse_prometheus(fed.render())
+    # federation label rule: same name/type, one new label
+    assert types["jobs_finished_total"] == "counter"
+    assert samples[
+        ("jobs_finished_total", (("state", "DONE"), ("replica", "r-a")))
+    ] == 4.0
+    assert samples[
+        ("job_seconds_count", (("kind", "prove"), ("replica", "r-b")))
+    ] == 10.0
+    # rollups: merged histogram, summed counters, rate over the tick
+    assert types["fleet_job_seconds"] == "histogram"
+    assert samples[
+        ("fleet_job_seconds_count", (("kind", "prove"),))
+    ] == 14.0
+    assert samples[
+        ("fleet_jobs_finished_total", (("state", "DONE"),))
+    ] == 14.0
+    # 10 -> 14 finished over the 2 s tick
+    assert samples[("fleet_jobs_per_second", ())] == pytest.approx(2.0)
+    assert samples[("fleet_max_burn_rate", ())] == 1.5
+    assert samples[("fleet_open_breakers", ())] == 1.0
+    assert samples[("fleet_replicas_scraped", ())] == 2.0
+    # merged p95 lands in r-b's 5 s bucket range, not r-a's sub-second
+    q95 = samples[
+        ("fleet_job_quantile_seconds", (("kind", "prove"), ("q", "0.95")))
+    ]
+    assert 1.0 < q95 <= 10.0
+
+    # ejection drops a replica out of the federated view
+    fed.retain({"r-a"})
+    types, samples = parse_prometheus(fed.render())
+    assert not any(
+        dict(labels).get("replica") == "r-b" for (_, labels) in samples
+    )
+    assert samples[("fleet_replicas_scraped", ())] == 1.0
+
+    # garbage never lands: counted, not half-ingested
+    before = fed.scrapes_failed
+    fed.note_scrape("r-c", "job_seconds{kind=unquoted} 1\n")
+    assert fed.scrapes_failed == before + 1
+    assert "r-c" not in fed.replicas()
+
+
+# -- fleet anomaly hook -------------------------------------------------------
+
+
+def test_fleet_anomaly_hook_dumps_once_per_episode(tmp_path):
+    from distributed_groth16_tpu.telemetry import flight
+    from distributed_groth16_tpu.telemetry import metrics as tm
+
+    router = FleetRouter(
+        FleetConfig(
+            replicas=(
+                ("http://a", None), ("http://b", None), ("http://c", None)
+            ),
+            anomaly_factor=2.0,
+        )
+    )
+    fast = _replica_exposition(6, runtime=0.5)
+    slow = _replica_exposition(6, runtime=50.0)
+    router.federator.note_scrape("r-1", fast)
+    router.federator.note_scrape("r-2", fast)
+    router.federator.note_scrape("r-3", slow)
+    anom = tm.registry().family("fleet_anomalies_total")
+
+    def count():
+        child = dict(anom.items()).get(("r-3", "p95_seconds"))
+        return child.value if child is not None else 0.0
+
+    flight.configure(str(tmp_path))
+    try:
+        base = count()
+        router._anomaly_pass()
+        dumps = sorted(tmp_path.glob("*fleet_anomaly*"))
+        assert len(dumps) == 1
+        assert count() == base + 1
+        post = json.loads(dumps[0].read_text())
+        assert post["trigger"] == "fleet_anomaly"
+        assert post["extra"]["replica"] == "r-3"
+        assert post["extra"]["signal"] == "p95_seconds"
+        assert post["extra"]["value"] > post["extra"]["fleetMedian"] * 2.0
+        # latched: the same episode never dumps twice
+        router._anomaly_pass()
+        assert len(list(tmp_path.glob("*fleet_anomaly*"))) == 1
+        assert count() == base + 1
+        # recovery re-arms; the next deviation is a new episode
+        router.federator.note_scrape("r-3", fast)
+        router._anomaly_pass()
+        router.federator.note_scrape("r-3", slow)
+        router._anomaly_pass()
+        assert len(list(tmp_path.glob("*fleet_anomaly*"))) == 2
+        assert count() == base + 2
+        # VANISHING re-arms too: an ejected replica's scrape drops out
+        # of the signal dict entirely (retain), and its next anomaly
+        # after rejoining must be a fresh episode, not a stale latch
+        router.federator.retain({"r-1", "r-2"})
+        router._anomaly_pass()
+        assert ("r-3", "p95_seconds") not in router._anomaly_latched
+        router.federator.note_scrape("r-3", slow)
+        router._anomaly_pass()
+        assert len(list(tmp_path.glob("*fleet_anomaly*"))) == 3
+        assert count() == base + 3
+    finally:
+        flight.disable()
+
+
+def test_fleet_anomaly_needs_quorum_and_knob_off():
+    from distributed_groth16_tpu.telemetry import flight
+
+    router = FleetRouter(
+        FleetConfig(
+            replicas=(("http://a", None), ("http://b", None)),
+            anomaly_factor=2.0,
+        )
+    )
+    router.federator.note_scrape("r-1", _replica_exposition(6, runtime=0.5))
+    router.federator.note_scrape("r-2", _replica_exposition(6, runtime=50.0))
+    router._anomaly_pass()  # only 2 replicas: median is meaningless, no-op
+    assert not router._anomaly_latched
+    # factor <= 0 disables the hook entirely
+    router.cfg = FleetConfig(replicas=router.cfg.replicas, anomaly_factor=0.0)
+    router.federator.note_scrape("r-3", _replica_exposition(6, runtime=0.5))
+    router._anomaly_pass()
+    assert not router._anomaly_latched
+    assert not flight.enabled()
+
+
+# -- journal trace-id round-trip ----------------------------------------------
+
+
+def test_journal_submit_record_carries_trace_id(tmp_path):
+    from distributed_groth16_tpu.service.journal import JobJournal
+
+    j = JobJournal(str(tmp_path / "wal"), fsync=False)
+    job = ProofJob(
+        kind="prove", circuit_id="c", fields={"witness_file": b"x"},
+        trace_id="trace-123",
+    )
+    j.append_submit(job)
+    j.close()
+    (entry,) = read_journal(str(tmp_path / "wal"))
+    assert entry.trace_id == "trace-123"
+    assert entry.replayable
+
+
+# -- CLI: fleet top -----------------------------------------------------------
+
+
+def test_cli_fleet_top_formatting():
+    from distributed_groth16_tpu.api.cli import format_fleet_top
+
+    reg = MetricsRegistry()
+    h = reg.histogram(
+        "job_seconds", "x", ("kind", "replica"), buckets=(1.0, 10.0)
+    )
+    for _ in range(4):
+        h.labels(kind="prove", replica="r-a").observe(0.5)
+    st = reg.counter("party_straggler_total", "x", ("party", "replica"))
+    st.labels(party="3", replica="r-a").inc(7)
+    st.labels(party="1", replica="r-a").inc(2)
+    reg.gauge("fleet_jobs_per_second", "x").set(1.25)
+    q = reg.gauge("fleet_job_quantile_seconds", "x", ("kind", "q"))
+    q.labels(kind="prove", q="0.5").set(0.4)
+    q.labels(kind="prove", q="0.95").set(0.9)
+    table = format_fleet_top(
+        {
+            "replicas": [
+                {
+                    "replicaId": "r-a", "state": "active", "score": 1.0,
+                    "queueDepth": 2, "running": 1, "workers": 2,
+                    "openBreakers": 0, "maxBurnRate": 0.2,
+                },
+                {
+                    "replicaId": "r-gone", "state": "ejected",
+                    "score": None, "queueDepth": None, "running": None,
+                    "workers": None, "openBreakers": None,
+                    "maxBurnRate": None,
+                },
+            ],
+            "pending": 3,
+            "handoffs": 1,
+        },
+        reg.render_prometheus(),
+    )
+    lines = table.splitlines()
+    assert lines[0].split()[:3] == ["REPLICA", "STATE", "SCORE"]
+    assert "r-a" in lines[1] and "active" in lines[1]
+    # the most-straggling party (argmax of the counter) shows per replica
+    assert lines[1].rstrip().endswith("3")
+    assert "r-gone" in lines[2] and "-" in lines[2]
+    footer = lines[-1]
+    assert "p50=0.4s" in footer and "p95=0.9s" in footer
+    assert "jobs/s=1.25" in footer
+    assert "pending=3" in footer and "handoffs=1" in footer
+    # an empty metrics body still renders the stats half
+    table = format_fleet_top({"replicas": [], "pending": 0}, "")
+    assert table.splitlines()[0].startswith("REPLICA")

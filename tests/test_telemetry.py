@@ -659,3 +659,77 @@ def test_metrics_endpoint_golden(circuit):
         samples.get(("crs_cache_hits_total", ()), 0)
         + samples.get(("crs_cache_misses_total", ()), 0)
     ) >= 0
+
+
+# -- exposition parsing + federation snapshot math (fleet observatory) -------
+
+
+def test_parse_exposition_roundtrips_the_renderer():
+    reg = tm.MetricsRegistry()
+    c = reg.counter("fx_total", "a counter", ("tenant",))
+    c.labels(tenant='we"ird\\t').inc(3)
+    reg.gauge("fx_gauge", "a gauge").set(-2.5)
+    h = reg.histogram("fx_seconds", "a histogram", ("kind",),
+                      buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 9.0):
+        h.labels(kind="prove").observe(v)
+    fams = tm.parse_exposition(reg.render_prometheus())
+    assert fams["fx_total"].kind == "counter"
+    assert fams["fx_gauge"].kind == "gauge"
+    assert fams["fx_seconds"].kind == "histogram"
+    # escaped label values round-trip
+    (sname, labels, value), = [
+        s for s in fams["fx_total"].samples if s[0] == "fx_total"
+    ]
+    assert labels == {"tenant": 'we"ird\\t'} and value == 3.0
+    # histogram suffixes attribute to the base family, +Inf parses
+    names = {s[0] for s in fams["fx_seconds"].samples}
+    assert names == {"fx_seconds_bucket", "fx_seconds_sum",
+                     "fx_seconds_count"}
+    inf_buckets = [
+        s for s in fams["fx_seconds"].samples
+        if s[0].endswith("_bucket") and s[1]["le"] == "+Inf"
+    ]
+    assert inf_buckets[0][2] == 3.0
+    # a spec-legal trailing millisecond timestamp parses (and is
+    # discarded) — exporters/sidecars append them
+    fam = tm.parse_exposition("ts_total 5 1700000000000\n")["ts_total"]
+    assert fam.samples == [("ts_total", {}, 5.0)]
+    # a malformed line is loud, not silently dropped
+    with pytest.raises(ValueError):
+        tm.parse_exposition("fx_total{tenant=unquoted} 1\n")
+    with pytest.raises(ValueError):
+        tm.parse_exposition("fx_total 1 garbage\n")
+
+
+def test_histogram_snapshots_merge_across_label_dims():
+    reg = tm.MetricsRegistry()
+    h = reg.histogram("js", "x", ("kind", "replica"), buckets=(1.0, 10.0))
+    h.labels(kind="prove", replica="a").observe(0.5)
+    h.labels(kind="prove", replica="b").observe(5.0)
+    h.labels(kind="mpc", replica="a").observe(5.0)
+    fam = tm.parse_exposition(reg.render_prometheus())["js"]
+    # group by kind: replicas merge (cumulative counts add)
+    by_kind = tm.histogram_snapshots(fam, group_by=("kind",))
+    prove = by_kind[("prove",)]
+    assert prove.count == 2 and prove.sum == pytest.approx(5.5)
+    assert prove.cumulative == [1.0, 2.0, 2.0]
+    # group by nothing: one fleet-wide snapshot
+    (all_snap,) = tm.histogram_snapshots(fam).values()
+    assert all_snap.count == 3 and all_snap.cumulative[-1] == 3.0
+
+
+def test_histogram_quantile_interpolates_and_clamps():
+    snap = tm.HistogramSnapshot(
+        bounds=(1.0, 2.0, float("inf")),
+        cumulative=[4.0, 8.0, 10.0],
+        sum=0.0,
+        count=10.0,
+    )
+    # rank 5 of 10 lands in the (1, 2] bucket: 1 + (5-4)/4
+    assert tm.histogram_quantile(snap, 0.5) == pytest.approx(1.25)
+    # ranks in the +Inf bucket answer the highest finite bound
+    assert tm.histogram_quantile(snap, 0.99) == pytest.approx(2.0)
+    # the empty snapshot is 0, not a crash
+    empty = tm.HistogramSnapshot((), [], 0.0, 0.0)
+    assert tm.histogram_quantile(empty, 0.95) == 0.0
