@@ -31,7 +31,12 @@ Usage:
   python -m distributed_groth16_tpu.api.cli perf run [--quick] \
       [--select msm_g1 ...] [--out perf.json]
   python -m distributed_groth16_tpu.api.cli perf top --run perf.json [-n 10]
-  python -m distributed_groth16_tpu.api.cli perf diff before.json after.json
+  python -m distributed_groth16_tpu.api.cli perf diff before.json after.json \
+      [--markdown]
+  python -m distributed_groth16_tpu.api.cli perf roofline [--run perf.json]
+  python -m distributed_groth16_tpu.api.cli profile capture [--seconds 3] \
+      [--out prof.tar.gz]
+  python -m distributed_groth16_tpu.api.cli profile status
 
 Queue-full submissions (HTTP 429) exit with the server's retryAfter hint
 (docs/SERVICE.md describes the backpressure semantics).
@@ -254,6 +259,58 @@ def cmd_metrics(args) -> dict:
     raise SystemExit(0)
 
 
+def cmd_profile_capture(args) -> dict:
+    """POST /profile against a LIVE server (mid-job is the point), poll
+    until the bounded capture finishes, and download the .tar.gz trace
+    artifact — open it in TensorBoard's profile plugin / Perfetto
+    (docs/OBSERVABILITY.md "Device observatory")."""
+    import time as _time
+
+    body = _body(
+        requests.post(
+            f"{args.url}/profile",
+            json={"durationS": args.seconds},
+            timeout=60,
+        )
+    )
+    capture_id = body["id"]
+    deadline = _time.monotonic() + args.seconds + args.pack_timeout
+    while True:
+        resp = requests.get(
+            f"{args.url}/profile/{capture_id}", timeout=120
+        )
+        ctype = resp.headers.get("Content-Type", "")
+        if resp.status_code == 200 and not ctype.startswith(
+            "application/json"
+        ):
+            break  # the artifact bytes
+        if resp.status_code not in (200, 202):
+            raise SystemExit(
+                f"profile capture {capture_id} failed: "
+                f"HTTP {resp.status_code} — {resp.text[:300]}"
+            )
+        if _time.monotonic() > deadline:
+            raise SystemExit(
+                f"profile capture {capture_id} still not ready after "
+                f"{args.seconds + args.pack_timeout:.0f}s"
+            )
+        _time.sleep(min(0.5, max(0.05, args.seconds / 4)))
+    out = args.out or f"profile-{capture_id}.tar.gz"
+    with open(out, "wb") as f:
+        f.write(resp.content)
+    return {
+        "id": capture_id,
+        "durationS": body["durationS"],
+        "out": out,
+        "bytes": len(resp.content),
+    }
+
+
+def cmd_profile_status(args) -> dict:
+    """GET /profile — the capture history + whichever capture runs now."""
+    return _body(requests.get(f"{args.url}/profile", timeout=60))
+
+
 _FLEET_COLUMNS = (
     # (header, /fleet/stats replica-row key)
     ("REPLICA", "replicaId"),
@@ -302,7 +359,7 @@ def cmd_fleet_status(args) -> dict:
 
 
 _TOP_COLUMNS = (
-    "REPLICA", "STATE", "SCORE", "QUEUED", "RUNNING",
+    "REPLICA", "VER", "STATE", "SCORE", "QUEUED", "RUNNING",
     "P95(s)", "BURN", "BREAKERS", "STRAGGLER",
 )
 
@@ -350,6 +407,9 @@ def format_fleet_top(stats: dict, metrics_text: str) -> str:
         rid = r.get("replicaId", "")
         rows.append([
             _fmt_cell(rid),
+            # the /readyz buildInfo version per replica — a rolling
+            # upgrade reads as a mixed VER column, not a mystery
+            _fmt_cell(r.get("version")),
             _fmt_cell(r.get("state")),
             _fmt_cell(r.get("score")),
             _fmt_cell(r.get("queueDepth")),
@@ -518,7 +578,9 @@ def cmd_perf_top(args) -> dict:
 
 def cmd_perf_diff(args) -> dict:
     """Per-kernel ratio between two recorded runs (B/A: < 1 means B is
-    faster) — the before/after view a perf PR ships with."""
+    faster) — the before/after view a perf PR ships with. `--markdown`
+    prints a GitHub-flavored table instead of JSON (the CI perf-smoke
+    lane pipes it into the step summary)."""
     run_a, run_b = _load_perf(args.run_a), _load_perf(args.run_b)
     ka, kb = run_a["kernels"], run_b["kernels"]
     rows = {}
@@ -536,13 +598,71 @@ def cmd_perf_diff(args) -> dict:
                 else None
             ),
         }
-    return {
+    out = {
         "a": args.run_a,
         "b": args.run_b,
         "kernels": rows,
         "onlyInA": sorted(set(ka) - set(kb)),
         "onlyInB": sorted(set(kb) - set(ka)),
     }
+    if getattr(args, "markdown", False):
+        print(format_perf_diff_markdown(out))
+        raise SystemExit(0)
+    return out
+
+
+def format_perf_diff_markdown(diff: dict) -> str:
+    """The `perf diff --markdown` table — pure string building so the CI
+    step-summary path is unit-testable without a runner."""
+    lines = [
+        f"### perf diff — `{diff['a']}` vs `{diff['b']}`",
+        "",
+        "| kernel | A (s) | B (s) | B/A |",
+        "| --- | --- | --- | --- |",
+    ]
+    for key in sorted(diff["kernels"]):
+        row = diff["kernels"][key]
+        if "error" in row:
+            lines.append(f"| `{key}` | — | — | errored: {row['error']} |")
+            continue
+        ratio = row["ratio"]
+        flag = ""
+        if ratio is not None:
+            flag = " 🔺" if ratio > 1.25 else (" ✅" if ratio < 0.8 else "")
+        lines.append(
+            f"| `{key}` | {row['aSeconds']:.6g} | {row['bSeconds']:.6g} "
+            f"| {ratio if ratio is not None else '—'}{flag} |"
+        )
+    for label, keys in (("only in A", diff["onlyInA"]),
+                        ("only in B", diff["onlyInB"])):
+        if keys:
+            lines.append("")
+            lines.append(f"_{label}: {', '.join(keys)}_")
+    return "\n".join(lines)
+
+
+def cmd_perf_roofline(args) -> dict:
+    """Roofline attribution table over a recorded dg16-perf/1 run (or a
+    fresh quick run when --run is absent): achieved FLOP/s and B/s,
+    arithmetic intensity, fraction of the binding roof, and whether each
+    kernel is compute- or memory-bound — against DG16_PEAK_FLOPS /
+    DG16_PEAK_BW or the device-kind peak table (docs/PERF.md "Roofline
+    workflow")."""
+    from ..telemetry import roofline
+
+    if args.run:
+        run = _load_perf(args.run)
+    else:
+        from ..telemetry import perf
+
+        try:
+            run = perf.run_suite(
+                quick=True, select=args.select, reps=args.reps
+            )
+        except KeyError as e:
+            raise SystemExit(f"perf: {e.args[0]}")
+    print(roofline.format_table(run))
+    raise SystemExit(0)
 
 
 def cmd_export_eth(args) -> dict:
@@ -699,7 +819,49 @@ def main(argv=None) -> None:
     sp = perf_sub.add_parser("diff", help="per-kernel ratio of two runs")
     sp.add_argument("run_a", help="baseline-side run JSON (A)")
     sp.add_argument("run_b", help="candidate-side run JSON (B)")
+    sp.add_argument("--markdown", action="store_true",
+                    help="print a GitHub-flavored table (for CI step "
+                         "summaries) instead of JSON")
     sp.set_defaults(fn=cmd_perf_diff)
+
+    sp = perf_sub.add_parser(
+        "roofline",
+        help="roofline attribution: utilization + compute/memory-bound "
+             "classification per device kernel (docs/PERF.md)",
+    )
+    sp.add_argument("--run", default=None,
+                    help="dg16-perf/1 run JSON to attribute (default: "
+                         "run the quick suite now)")
+    sp.add_argument("--select", nargs="+", metavar="KERNEL", default=None,
+                    help="only these registered kernels (no --run only)")
+    sp.add_argument("--reps", type=int, default=None,
+                    help="warm reps per case (no --run only)")
+    sp.set_defaults(fn=cmd_perf_roofline)
+
+    pp = sub.add_parser(
+        "profile",
+        help="on-demand XLA profiling of a LIVE server "
+             "(docs/OBSERVABILITY.md \"Device observatory\")",
+    )
+    psub = pp.add_subparsers(dest="profile_cmd", required=True)
+
+    sp = psub.add_parser(
+        "capture",
+        help="start a bounded capture mid-job, wait, download the "
+             ".tar.gz trace artifact",
+    )
+    sp.add_argument("--seconds", type=float, default=3.0,
+                    help="capture duration (server clamps to "
+                         "DG16_PROF_MAX_S)")
+    sp.add_argument("--out", default=None,
+                    help="artifact path (default profile-<id>.tar.gz)")
+    sp.add_argument("--pack-timeout", type=float, default=120.0,
+                    help="extra seconds to wait for the artifact pack "
+                         "after the capture window closes")
+    sp.set_defaults(fn=cmd_profile_capture)
+
+    sp = psub.add_parser("status", help="capture history (GET /profile)")
+    sp.set_defaults(fn=cmd_profile_status)
 
     sp = sub.add_parser("verify")
     sp.add_argument("--circuit-id", required=True)

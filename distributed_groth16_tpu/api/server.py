@@ -40,7 +40,14 @@ routes above, funnels through one queue + bounded worker pool):
   GET    /stats           queue depth/counters, CRS-cache hit rate,
                           per-phase timing aggregates, batching-scheduler
                           bucket/placement state when DG16_BATCH_MAX > 1
-                          (docs/SCHEDULER.md)
+                          (docs/SCHEDULER.md), profiler capture history
+  POST   /profile         start one bounded on-demand XLA profiler capture
+                          ({"durationS": 3}; single-flight — 409 while one
+                          runs; docs/OBSERVABILITY.md "Device observatory")
+  GET    /profile         capture history + the running capture id
+  GET    /profile/{id}    the capture's .tar.gz trace artifact once done
+                          (202 JSON while it still runs; `dg16-cli profile
+                          capture` wraps the whole flow)
   GET    /slo             SLO burn-rate document per job kind (enabled via
                           DG16_SLO_TARGET_S / DG16_SLO_TARGETS; the
                           per-replica signal a router/autoscaler polls —
@@ -69,7 +76,10 @@ from aiohttp import web
 
 from ..frontend.ark_serde import proof_from_bytes
 from ..models.groth16 import verify
+from ..telemetry import buildinfo as telemetry_buildinfo
+from ..telemetry import devmem as telemetry_devmem
 from ..telemetry import metrics as telemetry_metrics
+from ..telemetry import profiler as telemetry_profiler
 from ..telemetry.aggregate import now_ns as _trace_now_ns
 from ..service import (
     CrsCache,
@@ -83,7 +93,13 @@ from ..service import (
     WorkerPool,
 )
 from ..service.slo import disabled_doc as _slo_disabled
-from ..utils.config import SchedulerConfig, ServiceConfig, SLOConfig
+from ..utils.config import (
+    SchedulerConfig,
+    ServiceConfig,
+    SLOConfig,
+    env_float,
+    env_str,
+)
 from .store import CircuitStore
 
 log = logging.getLogger(__name__)
@@ -195,6 +211,18 @@ class ApiServer:
             self.queue, self.executor, self.cfg.workers,
             scheduler=self.scheduler,
         )
+        # device observatory (docs/OBSERVABILITY.md): on-demand XLA
+        # profiler (artifacts under DG16_PROF_DIR, default a _profiles
+        # dir next to the circuit store) and the HBM gauge sampler
+        self.profiler = telemetry_profiler.Profiler(
+            env_str("DG16_PROF_DIR", "")
+            or os.path.join(self.store.root, "_profiles")
+        )
+        self.devmem_sample_s = env_float("DG16_DEVMEM_SAMPLE_S", 10.0)
+        self._devmem_task: asyncio.Task | None = None
+        # constant-1 identity gauge + the /readyz buildInfo block — how a
+        # mixed-version fleet shows up in `fleet top`
+        self.build_info = telemetry_buildinfo.build_info()
 
     # -- job plumbing --------------------------------------------------------
 
@@ -553,6 +581,10 @@ class ApiServer:
             "queueBound": s["queueBound"],
             "running": s["running"],
             "maxBurnRate": round(max_burn, 4),
+            # build identity (telemetry/buildinfo.py): the fleet registry
+            # keeps it per replica so `fleet top` shows a mixed-version
+            # fleet during a rolling upgrade
+            "buildInfo": self.build_info,
         }
         echo = request.query.get("echo")
         if echo is not None:
@@ -607,6 +639,7 @@ class ApiServer:
                     if self.slo is not None
                     else _slo_disabled()
                 ),
+                "profiler": self.profiler.stats(),
             }
         )
 
@@ -625,6 +658,60 @@ class ApiServer:
             charset="utf-8",
         )
 
+    # -- on-demand profiling (docs/OBSERVABILITY.md "Device observatory") ----
+
+    async def profile_start(self, request):
+        """POST /profile — begin one bounded single-flight XLA capture
+        mid-job; 409 while another runs. The start itself is cheap but
+        runs off the loop (jax.profiler spins up collector threads)."""
+        duration = telemetry_profiler.DEFAULT_DURATION_S
+        if request.can_read_body:
+            try:
+                body = await request.json()
+                duration = float(body.get("durationS", duration))
+            except (ValueError, TypeError, AttributeError):
+                # not JSON, not an object, or durationS not a number —
+                # all the same 400, never a 500 traceback
+                return _error(
+                    "body must be JSON like {\"durationS\": 3}", status=400
+                )
+        if duration <= 0:
+            return _error("durationS must be > 0", status=400)
+        try:
+            cap = await asyncio.to_thread(self.profiler.start, duration)
+        except telemetry_profiler.ProfileBusyError as e:
+            return _error(str(e), status=409)
+        except telemetry_profiler.ProfileError as e:
+            return _error(str(e))
+        return web.json_response(
+            {"id": cap.id, "state": cap.state, "durationS": cap.duration_s},
+            status=202,
+        )
+
+    async def profile_status(self, request):
+        """GET /profile — capture history + whichever capture runs now."""
+        return web.json_response(self.profiler.stats())
+
+    async def profile_artifact(self, request):
+        """GET /profile/{id} — the .tar.gz trace artifact once the capture
+        finished; 202 JSON while it still runs (poll), 404 unknown id,
+        500 when the capture errored."""
+        cap = self.profiler.get(request.match_info["capture_id"])
+        if cap is None:
+            return _error("unknown capture id", status=404)
+        if cap.state == "running":
+            return web.json_response(cap.to_dict(), status=202)
+        if cap.state != "done" or not cap.artifact:
+            return _error(cap.error or "capture failed")
+        return web.FileResponse(
+            cap.artifact,
+            headers={
+                "Content-Type": "application/gzip",
+                "Content-Disposition":
+                    f'attachment; filename="profile-{cap.id}.tar.gz"',
+            },
+        )
+
     # -- app -----------------------------------------------------------------
 
     async def _on_startup(self, app):
@@ -635,6 +722,8 @@ class ApiServer:
         await self.pool.start()
         if self.slo is not None:
             self._slo_task = asyncio.create_task(self._slo_loop())
+        if self.devmem_sample_s > 0:
+            self._devmem_task = asyncio.create_task(self._devmem_loop())
         self._install_signal_handlers()
 
     async def _slo_loop(self) -> None:
@@ -645,6 +734,15 @@ class ApiServer:
             await asyncio.sleep(self.slo_cfg.sample_s)
             self.slo.sample()
 
+    async def _devmem_loop(self) -> None:
+        """Background device-memory sampler: keeps the
+        device_memory_bytes{device,kind} gauges fresh between jobs
+        (DG16_DEVMEM_SAMPLE_S; a no-op data-wise on XLA:CPU, where the
+        backend reports no stats)."""
+        while True:
+            await asyncio.sleep(self.devmem_sample_s)
+            await asyncio.to_thread(telemetry_devmem.sample)
+
     async def _on_cleanup(self, app):
         if self._slo_task is not None:
             self._slo_task.cancel()
@@ -653,6 +751,17 @@ class ApiServer:
             except asyncio.CancelledError:
                 pass
             self._slo_task = None
+        if self._devmem_task is not None:
+            self._devmem_task.cancel()
+            try:
+                await self._devmem_task
+            except asyncio.CancelledError:
+                pass
+            self._devmem_task = None
+        # a capture left running would outlive its server: stop + pack it
+        # off the loop — the tar pack is minutes-scale under a saturated
+        # CPU and must not stall the rest of teardown
+        await asyncio.to_thread(self.profiler.stop)
         await self.pool.stop()
         self._remove_signal_handlers()
         if self.journal is not None:
@@ -731,6 +840,9 @@ class ApiServer:
         app.router.add_get("/stats", self.stats)
         app.router.add_get("/slo", self.slo_status)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_post("/profile", self.profile_start)
+        app.router.add_get("/profile", self.profile_status)
+        app.router.add_get("/profile/{capture_id}", self.profile_artifact)
         return app
 
 

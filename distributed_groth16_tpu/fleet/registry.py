@@ -252,6 +252,10 @@ class ReplicaRegistry:
                     "devices": doc.get("devices"),
                     "openBreakers": doc.get("openBreakers"),
                     "maxBurnRate": doc.get("maxBurnRate"),
+                    # the replica's /readyz buildInfo (package version) —
+                    # `fleet top` renders it so a rolling upgrade shows
+                    # up as a mixed VER column
+                    "version": (doc.get("buildInfo") or {}).get("version"),
                     "journal": r.journal_dir,
                     "pollsOk": r.polls_ok,
                     "pollsFailed": r.polls_failed,

@@ -34,8 +34,10 @@ from ..models.groth16.prove import PartyProofShare
 from ..ops.field import fr
 from ..service.jobs import JobCancelled
 from ..parallel.pss import PackedSharingParams
+from ..telemetry import devmem as _devmem
 from ..telemetry import metrics as _tm
 from ..telemetry import tracing as _tracing
+from ..telemetry import transfer as _transfer
 
 _REG = _tm.registry()
 _BATCH_SECONDS = _REG.histogram(
@@ -130,13 +132,17 @@ def prove_batch(
         qabc_rows.append(qabc_rows[0])
         a_rows.append(a_rows[0])
         ax_rows.append(ax_rows[0])
-    qabc = jnp.stack(
-        [jnp.stack([qabc_rows[j][i] for j in range(b_pad)], axis=0)
-         for i in range(pp.n)],
-        axis=0,
-    )  # (n, B, 3, m/l, 16)
-    a_sh = jnp.stack(a_rows, axis=1)  # (n, B, c_a, 16)
-    ax_sh = jnp.stack(ax_rows, axis=1)
+    # the batched witness-upload boundary: the per-job rows stack into
+    # the (n, B, ...) device tensors the SPMD program consumes
+    with _transfer.account("h2d") as t:
+        qabc = jnp.stack(
+            [jnp.stack([qabc_rows[j][i] for j in range(b_pad)], axis=0)
+             for i in range(pp.n)],
+            axis=0,
+        )  # (n, B, 3, m/l, 16)
+        a_sh = jnp.stack(a_rows, axis=1)  # (n, B, c_a, 16)
+        ax_sh = jnp.stack(ax_rows, axis=1)
+        t.add_tree((qabc, a_sh, ax_sh))
     s_q = jnp.stack([c.s for c in crs_shares])
     u_q = jnp.stack([c.u for c in crs_shares])
     v_q = jnp.stack([c.v for c in crs_shares])
@@ -144,12 +150,17 @@ def prove_batch(
     if prover is None:
         prover = build_batch_mesh_prover(pp, pk.domain_size, mesh, b_pad)
     pa, pb, pc = prover(qabc, a_sh, ax_sh, s_q, u_q, v_q, w_q)
-    return [
-        reassemble_proof(
-            PartyProofShare(a=pa[0, j], b=pb[0, j], c=pc[0, j]), pk
-        )
-        for j in range(B)
-    ]
+    # the batched proof-readback boundary: reassembly pulls shard 0's
+    # clear cores host-side, one (a, b, c) triple per real job
+    with _transfer.account("d2h") as t:
+        proofs = [
+            reassemble_proof(
+                PartyProofShare(a=pa[0, j], b=pb[0, j], c=pc[0, j]), pk
+            )
+            for j in range(B)
+        ]
+        t.add_tree([(pa[0, j], pb[0, j], pc[0, j]) for j in range(B)])
+    return proofs
 
 
 class BatchProver:
@@ -211,6 +222,10 @@ class BatchProver:
                 t0 = time.monotonic()
                 for job in good:
                     job.note_phase("batch_prove")
+                # per-BATCH device-memory bracket: one mesh execution is
+                # the allocation event; every batchmate gets the same
+                # stamp (None-safe on XLA:CPU)
+                peak0 = _devmem.peak_bytes()
                 try:
                     prover = self.provers.get_or_build(
                         cache_key,
@@ -231,6 +246,11 @@ class BatchProver:
                         outcomes.append((job, fault))
                     return outcomes
                 prove_s = time.monotonic() - t0
+                mem = _devmem.peak_delta(peak0, _devmem.peak_bytes())
+                if mem is not None:
+                    mem["batchSize"] = len(good)
+                    for job in good:
+                        job.note_device_memory(dict(mem))
                 share = 1.0 / len(good)
                 for job, proof in zip(good, proofs):
                     job.timings.record("load", load_s * share)

@@ -140,6 +140,10 @@ class ProofJob:
         # thread, read at the loop-side terminal transition (a str swap
         # is atomic, no lock needed)
         self._phase: str | None = None
+        # device-memory stamp (telemetry/devmem.py): how much this job
+        # raised the process HBM peak — written by the executor / batch
+        # prover thread, None on backends without memory_stats (XLA:CPU)
+        self._device_memory: dict | None = None
 
     # -- executor-side hooks (worker thread) --------------------------------
 
@@ -153,6 +157,13 @@ class ProofJob:
         """Executors stamp the phase they are entering so a failure DTO
         can say WHERE the job died ({type, message, phase})."""
         self._phase = name
+
+    def note_device_memory(self, doc: dict | None) -> None:
+        """Stamp the job's device-memory footprint ({peakBytes,
+        peakDeltaBytes}, plus batchSize on the batched path) into the
+        status DTO — None-safe where the backend reports nothing."""
+        if doc is not None:
+            self._device_memory = doc
 
     # -- loop-side transitions ----------------------------------------------
 
@@ -289,12 +300,14 @@ class ProofJob:
                     "spans": json.loads(self._spans_json),
                     "droppedSpans": self._dropped_spans,
                     "criticalPath": self._critical_path,
+                    "deviceMemory": self._device_memory,
                 }
                 if self._spans_json is not None
                 else {
                     "spans": self.trace.span_tree(),
                     "droppedSpans": self.trace.dropped,
                     "criticalPath": None,
+                    "deviceMemory": self._device_memory,
                 }
             ),
         }

@@ -32,7 +32,7 @@ from ..models.groth16.prove import prove_single
 from ..ops.field import fr
 from ..parallel.net import job_context, run_round_with_retries
 from ..parallel.pss import PackedSharingParams
-from ..telemetry import aggregate, tracing
+from ..telemetry import aggregate, devmem, tracing, transfer
 from ..utils.config import ServiceConfig
 from ..utils.timers import phase
 from .crs_cache import CrsCache
@@ -99,11 +99,20 @@ class ProofExecutor:
     def packed_crs(self, job: ProofJob, pk, pp: PackedSharingParams):
         """All-party CRS shares through the LRU cache. The key is the
         circuit plus every parameter the shares depend on (l determines
-        n/t and the chunking)."""
+        n/t and the chunking). A cache MISS is the packed-CRS
+        host->device boundary: the factory accounts the share bytes it
+        materialized on device (hits move nothing, and count nothing)."""
+
+        def _pack():
+            with transfer.account("h2d") as t:
+                shares = pack_proving_key(pk, pp, strip=True)
+                # PackedProvingKeyShare is a plain dataclass, not a
+                # registered pytree — count its array fields explicitly
+                t.add_tree([tuple(vars(sh).values()) for sh in shares])
+            return shares
+
         key = (job.circuit_id, pp.l)
-        return self.crs_cache.get_or_pack(
-            key, lambda: pack_proving_key(pk, pp, strip=True)
-        )
+        return self.crs_cache.get_or_pack(key, _pack)
 
     # -- the proving path ----------------------------------------------------
 
@@ -118,10 +127,19 @@ class ProofExecutor:
             # observatory"): every span nested under the job root joins
             # the router-minted trace via this attribute
             attrs["trace"] = job.trace_id
-        with tracing.collect(job.trace), job_context(job.id), tracing.span(
-            "job", job=job.id, attrs=attrs,
-        ):
-            return self._run(job)
+        # bracket the job with the device-memory peak so the DTO can say
+        # how much IT raised the process HBM high-water mark (None on
+        # XLA:CPU — devmem is None-safe end to end)
+        peak0 = devmem.peak_bytes()
+        try:
+            with tracing.collect(job.trace), job_context(job.id), tracing.span(
+                "job", job=job.id, attrs=attrs,
+            ):
+                return self._run(job)
+        finally:
+            job.note_device_memory(
+                devmem.peak_delta(peak0, devmem.peak_bytes())
+            )
 
     def _run(self, job: ProofJob) -> dict:
         timings = job.timings
@@ -134,7 +152,11 @@ class ProofExecutor:
             z = self.resolve_witness(job, r1cs)
         job.check_cancel()
         F = fr()
-        z_mont = F.encode(z)
+        # the witness-upload boundary: F.encode materializes the (wires,
+        # 16) Montgomery limb tensor on device from host bigints
+        with transfer.account("h2d") as t:
+            z_mont = F.encode(z)
+            t.add_tree(z_mont)
         if job.kind == "prove":
             job.note_phase("prove")
             with phase("prove", timings):
@@ -182,9 +204,14 @@ class ProofExecutor:
             raise ValueError(f"unknown job kind {job.kind!r}")
         job.note_phase(None)
         job.check_cancel()
+        # the proof-readback boundary: serializing pulls the proof's
+        # device-resident curve points back to host
+        with transfer.account("d2h") as t:
+            proof_bytes = proof_to_bytes(proof)
+            t.add(len(proof_bytes))
         return {
             "circuitId": job.circuit_id,
-            "proof": list(proof_to_bytes(proof)),
+            "proof": list(proof_bytes),
             "phases": timings.as_millis(),
         }
 

@@ -6,12 +6,17 @@ compile-cost accounting (compile.py). Every layer — transport,
 distributed kernels, prover, service, API, bench — records through here;
 docs/OBSERVABILITY.md is the catalog and naming convention.
 
-The performance observatory (perf.py registry + runner, perf_kernels.py
-cases, benchgate.py regression gate) is NOT imported here: it pulls in
-ops/ and is loaded lazily by its consumers (`tools/benchgate`,
-`dg16-cli perf`, bench.py) so importing the spine stays cheap.
+The device observatory (docs/OBSERVABILITY.md "Device observatory")
+rides the same spine: devmem.py (HBM gauges/snapshots), transfer.py
+(host<->device boundary accounting), profiler.py (on-demand XLA capture),
+roofline.py and buildinfo.py. devmem/transfer register their families
+here; profiler/roofline/perf stay lazy like the performance observatory
+(perf.py registry + runner, perf_kernels.py cases, benchgate.py
+regression gate), which pulls in ops/ and is loaded by its consumers
+(`tools/benchgate`, `dg16-cli perf`, bench.py) so importing the spine
+stays cheap.
 """
 
-from . import aggregate, flight, metrics, tracing  # noqa: F401
+from . import aggregate, devmem, flight, metrics, tracing, transfer  # noqa: F401
 from .metrics import registry  # noqa: F401
 from .tracing import TraceBuffer, collect, span  # noqa: F401

@@ -298,6 +298,10 @@ def main(argv=None) -> int:
                          "instead of gating against it")
     ap.add_argument("--out", default=None,
                     help="write the run document (dg16-perf/1 JSON) here")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture an XLA profiler trace of the kernel run "
+                         "and write the .tar.gz artifact under DIR "
+                         "(telemetry/profiler.py; ignored with --check)")
     ap.add_argument("--check", metavar="RUN_JSON", default=None,
                     help="gate a previously recorded run instead of "
                          "running kernels")
@@ -327,9 +331,28 @@ def main(argv=None) -> int:
             from . import perf
 
             try:
-                run = perf.run_suite(
-                    quick=args.quick, select=args.select, reps=args.reps
-                )
+                if args.profile:
+                    # one artifact per gated run: the XLA timeline that
+                    # explains the numbers the gate is about to judge
+                    from . import profiler as _profiler
+
+                    with _profiler.capture_during(args.profile) as cd:
+                        run = perf.run_suite(
+                            quick=args.quick, select=args.select,
+                            reps=args.reps,
+                        )
+                    cap = cd.capture
+                    if cap is not None and cap.state == "done":
+                        print(f"benchgate: profiler artifact {cap.artifact}")
+                    elif cap is not None:
+                        print(
+                            f"benchgate: profiler capture failed: {cap.error}",
+                            file=sys.stderr,
+                        )
+                else:
+                    run = perf.run_suite(
+                        quick=args.quick, select=args.select, reps=args.reps
+                    )
             except KeyError as e:
                 # a --select typo must not exit 1 — that code means
                 # "perf regression" to CI scripting

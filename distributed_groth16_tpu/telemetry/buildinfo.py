@@ -1,0 +1,60 @@
+"""Build/runtime identity: the `dg16_build_info` gauge.
+
+The Prometheus build-info idiom: a constant-1 gauge whose LABELS carry
+the identity — package version, jax version, backend, device kind — so a
+scrape (and the fleet's federated view, where every series gains a
+`replica` label) can say which replica runs what. The same document rides
+the `/readyz` capacity body (`buildInfo`), which is how `dg16-cli fleet
+top` shows a mixed-version fleet during a rolling upgrade.
+
+Resolved lazily (jax backend init is not free) and exactly once per
+process; `build_info()` is idempotent.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import metrics as _tm
+
+_REG = _tm.registry()
+_BUILD_INFO = _REG.gauge(
+    "dg16_build_info",
+    "Constant 1; the labels carry the package version, jax version, "
+    "backend, and device kind of this process (join dashboards on it)",
+    ("version", "jax", "backend", "device"),
+)
+
+_lock = threading.Lock()
+_doc: dict | None = None
+
+
+def build_info() -> dict:
+    """Resolve (once) and return the identity document, setting the
+    labeled gauge so `/metrics` exports it."""
+    global _doc
+    with _lock:
+        if _doc is not None:
+            return _doc
+        try:
+            import jax
+
+            from .. import __version__
+
+            backend = jax.default_backend()
+            devices = jax.devices()
+            kind = str(devices[0].device_kind) if devices else "none"
+            jax_version = jax.__version__
+            version = __version__
+        except Exception:  # noqa: BLE001 — identity must never fail a scrape
+            version, jax_version, backend, kind = "unknown", "?", "?", "?"
+        _BUILD_INFO.labels(
+            version=version, jax=jax_version, backend=backend, device=kind
+        ).set(1)
+        _doc = {
+            "version": version,
+            "jax": jax_version,
+            "backend": backend,
+            "deviceKind": kind,
+        }
+        return _doc

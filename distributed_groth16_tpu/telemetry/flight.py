@@ -114,6 +114,11 @@ class FlightRecorder:
             seq = self._seq
             spans = list(self._spans)
             net = list(self._net)
+        # HBM state at dump time (telemetry/devmem.py; None-valued per
+        # device on XLA:CPU) — an OOM post-mortem must say how full the
+        # device was, not just which Python frame died
+        from . import devmem as _devmem
+
         record = {
             "trigger": trigger,
             "wallTime": time.time(),
@@ -123,6 +128,7 @@ class FlightRecorder:
             "extra": extra or {},
             "netEvents": net,
             "spans": spans,
+            "deviceMemory": _devmem.snapshot(),
             "metrics": _tm.registry().snapshot(),
         }
         name = f"flight-p{party if party is not None else 'x'}-" \

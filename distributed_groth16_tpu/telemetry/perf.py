@@ -37,6 +37,7 @@ from typing import Callable, Sequence
 
 from . import compile as _compile
 from . import metrics as _tm
+from . import roofline as _roofline
 from ..utils import config as _config
 
 PERF_SCHEMA = "dg16-perf/1"
@@ -67,6 +68,12 @@ _KERNEL_FLOPS = _REG.gauge(
 _KERNEL_BYTES = _REG.gauge(
     "perf_kernel_bytes",
     "XLA cost_analysis bytes-accessed estimate for the compiled kernel",
+    ("kernel", "size"),
+)
+_KERNEL_UTIL = _REG.gauge(
+    "perf_kernel_utilization",
+    "Fraction of the binding roofline roof the kernel achieved in the "
+    "last run (telemetry/roofline.py; DG16_PEAK_FLOPS/DG16_PEAK_BW)",
     ("kernel", "size"),
 )
 
@@ -301,6 +308,12 @@ def make_record(
         "memory": memory,
         "host": host,
     }
+    # roofline attribution (telemetry/roofline.py): device records with a
+    # cost model also say which roof they lean on and how hard — the
+    # device/host split BENCH_r0x's "kernels" section reports
+    rec["roofline"] = (
+        _roofline.attribute(cost, med) if not host else None
+    )
     if extra:
         rec.update(extra)
     sz = f"2e{size}"
@@ -314,6 +327,10 @@ def make_record(
         _KERNEL_FLOPS.labels(kernel=kernel, size=sz).set(cost["flops"])
         _KERNEL_BYTES.labels(kernel=kernel, size=sz).set(
             cost["bytes_accessed"]
+        )
+    if rec["roofline"] is not None:
+        _KERNEL_UTIL.labels(kernel=kernel, size=sz).set(
+            rec["roofline"]["utilization"]
         )
     return rec
 
@@ -342,6 +359,9 @@ def run_suite(
         "schema": PERF_SCHEMA,
         "platform": jax.default_backend(),
         "quick": bool(quick),
+        # the peak table this run's roofline attribution used, so a
+        # recorded document is self-describing (and re-attributable)
+        "peaks": _roofline.peaks(),
         "kernels": {},
     }
     reps = reps if reps is not None else default_reps(quick)

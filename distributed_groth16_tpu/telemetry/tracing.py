@@ -57,6 +57,21 @@ _global_path: str | None = None
 # check — `span()` must remain allocation-free with nothing installed.
 _extra_sinks: tuple = ()
 
+# Device-timeline annotator (telemetry/profiler.py): while an on-demand
+# XLA capture runs, every span ALSO enters a `jax.profiler.TraceAnnotation`
+# of the same name, so job phases line up with XLA ops in the downloaded
+# trace. None except during a capture — the idle fast path pays one extra
+# `is None` check and still allocates nothing.
+_annotator = None
+
+
+def set_annotator(factory) -> None:
+    """Install (or, with None, remove) the device-timeline annotation
+    factory: a callable `name -> context manager` entered for the span's
+    extent. Installed only while a profiler capture is live."""
+    global _annotator
+    _annotator = factory
+
 
 def add_sink(sink) -> None:
     """Install an extra span sink (anything with `.add(ev)`); spans record
@@ -183,10 +198,10 @@ def _tid() -> int:
 class Span:
     __slots__ = (
         "name", "bufs", "timings", "pid", "attrs",
-        "id", "parent_id", "_token", "t0",
+        "id", "parent_id", "_token", "t0", "annotation",
     )
 
-    def __init__(self, name, bufs, timings, pid, attrs):
+    def __init__(self, name, bufs, timings, pid, attrs, annotation=None):
         self.name = name
         self.bufs = bufs
         self.timings = timings
@@ -196,6 +211,7 @@ class Span:
         self.parent_id = 0
         self._token = None
         self.t0 = 0.0
+        self.annotation = annotation
 
     def __enter__(self):
         parent = _CURRENT.get()
@@ -204,11 +220,21 @@ class Span:
             if self.pid is None:
                 self.pid = parent.pid
         self._token = _CURRENT.set(self)
+        if self.annotation is not None:
+            try:
+                self.annotation.__enter__()
+            except Exception:  # noqa: BLE001 — profiling must never fail work
+                self.annotation = None
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, etype, evalue, tb):
         dt = time.perf_counter() - self.t0
+        if self.annotation is not None:
+            try:
+                self.annotation.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                pass
         _CURRENT.reset(self._token)
         if self.timings is not None:
             self.timings.record(self.name, dt)
@@ -249,7 +275,7 @@ def span(
     g = _global_buffer
     x = _extra_sinks
     if b is None and g is None and not x:
-        if timings is None:
+        if timings is None and _annotator is None:
             return NOOP
         bufs = ()
     elif not x:
@@ -274,7 +300,14 @@ def span(
             a["sid"] = sid
         if job is not None:
             a["job"] = job
-    return Span(name, bufs, timings, party, a)
+    ann = _annotator
+    annotation = None
+    if ann is not None:
+        try:
+            annotation = ann(name)
+        except Exception:  # noqa: BLE001 — a capture teardown race is benign
+            annotation = None
+    return Span(name, bufs, timings, party, a, annotation)
 
 
 def active() -> bool:

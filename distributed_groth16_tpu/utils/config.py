@@ -60,6 +60,12 @@ KNOBS: dict[str, str] = {
     "DG16_PERF_REPS": "benchgate warm reps per kernel case",
     "DG16_PERF_REL_THRESHOLD": "benchgate relative slowdown gate",
     "DG16_PERF_ABS_FLOOR_S": "benchgate absolute-seconds noise floor",
+    # device observatory (docs/OBSERVABILITY.md "Device observatory")
+    "DG16_PROF_DIR": "on-demand XLA profiler artifact directory",
+    "DG16_PROF_MAX_S": "cap on one POST /profile capture duration",
+    "DG16_DEVMEM_SAMPLE_S": "device-memory sampler period, <=0 off",
+    "DG16_PEAK_FLOPS": "roofline peak flops/sec override for this backend",
+    "DG16_PEAK_BW": "roofline peak HBM bytes/sec override for this backend",
     # fleet plane (docs/FLEET.md)
     "DG16_FLEET_REPLICAS": "router replica set: url[=journal-dir] CSV",
     "DG16_FLEET_POLL_S": "router discovery poll period seconds",

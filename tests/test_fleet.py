@@ -1092,7 +1092,7 @@ def test_cli_fleet_top_formatting():
         reg.render_prometheus(),
     )
     lines = table.splitlines()
-    assert lines[0].split()[:3] == ["REPLICA", "STATE", "SCORE"]
+    assert lines[0].split()[:4] == ["REPLICA", "VER", "STATE", "SCORE"]
     assert "r-a" in lines[1] and "active" in lines[1]
     # the most-straggling party (argmax of the counter) shows per replica
     assert lines[1].rstrip().endswith("3")

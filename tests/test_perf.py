@@ -286,6 +286,55 @@ def test_run_kernel_device_record_shape():
     assert snap['perf_kernel_seconds_count{kernel="_t_dev",size="2e6"}'] == 3
 
 
+def test_run_kernel_without_memory_stats_keeps_record_shape():
+    """ISSUE 14 satellite: XLA:CPU has no memory_stats() — the record must
+    carry an explicit None peak (never a fabricated number) and every
+    other field must stay intact, so benchgate and the dashboards read
+    CPU runs without special-casing."""
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.default_backend() == "cpu"  # the backend under test
+    assert jax.devices()[0].memory_stats() is None
+
+    def build(log2n):
+        n = 1 << log2n
+        x = jnp.arange(n, dtype=jnp.float32)
+        return perf.KernelCase(jax.jit(lambda v: (v + 1.0).sum()), (x,), n)
+
+    spec = perf.KernelSpec("_t_nomem", build, (5,), (5,), "items/sec", False)
+    rec = perf.run_kernel(spec, 5, reps=2)
+    assert rec["memory"] is not None and rec["memory"]["peak_bytes"] is None
+    assert rec["memory"]["argument_bytes"] >= 0
+    assert rec["cost"] is not None  # XLA's cost model still answers on CPU
+    assert rec["median_seconds"] > 0 and rec["items_per_sec"] > 0
+    assert rec["roofline"] is not None  # attribution needs cost, not memory
+
+
+def test_timed_jit_zero_compile_delta_on_cache_hit():
+    """ISSUE 14 satellite: a signature-cache hit must report a ZERO
+    compile-seconds delta — the number perf.run_kernel reads back as the
+    histogram-sum difference around the warm call."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_groth16_tpu.telemetry import compile as tcompile
+
+    tj = tcompile.timed_jit("_t_hit", jax.jit(lambda v: (v * 5.0).sum()))
+    x = jnp.arange(32, dtype=jnp.float32)
+    child = tm.registry().family("compile_seconds").labels(fn="_t_hit")
+    hits = tm.registry().family("compile_cache_hits_total").labels(
+        fn="_t_hit"
+    )
+    tj(x)  # miss: observed into the histogram
+    after_first = child.sum
+    assert after_first > 0.0
+    hits_before = hits.value
+    tj(x)  # hit: the delta the perf runner would read must be exactly 0
+    assert child.sum == after_first
+    assert hits.value == hits_before + 1
+
+
 def test_run_kernel_host_record_shape():
     def build(log2n):
         return perf.KernelCase(lambda: sum(range(1 << log2n)), (), 1 << log2n)
