@@ -80,6 +80,6 @@ def render_json(
 
 def write_json(path: str, payload: str) -> None:
     if path == "-":
-        print(payload)
+        print(payload)  # dg16lint: disable=DG108 — "-" means stdout
     else:
         Path(path).write_text(payload + "\n")

@@ -8,4 +8,5 @@ from . import (  # noqa: F401
     dg105_lock_discipline,
     dg106_tracer_hygiene,
     dg107_collective_pairing,
+    dg108_print_discipline,
 )

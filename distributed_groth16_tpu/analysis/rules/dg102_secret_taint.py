@@ -94,6 +94,10 @@ def _sink_kind(call: ast.Call) -> str | None:
             "flight" in recv or recv == "self"
         ):
             return "flight-recorder"
+        if attr == "bind" and recv is not None and "logbus" in recv:
+            # logbus.bind(tenant=...) stamps its kwargs onto every
+            # subsequent ring record — a log sink in slow motion
+            return "log"
     else:
         if name == "span":
             return "span attr"

@@ -24,6 +24,8 @@ Usage:
       [--journal DIR | --store DIR]
   python -m distributed_groth16_tpu.api.cli trace JOB [--out trace.json] \
       [--router http://router:8080]
+  python -m distributed_groth16_tpu.api.cli logs [--level WARNING] \
+      [--trace ID | --job ID] [--follow] [--router http://router:8080]
   python -m distributed_groth16_tpu.api.cli metrics
   python -m distributed_groth16_tpu.api.cli fleet status
   python -m distributed_groth16_tpu.api.cli fleet top [--interval 2] [--once]
@@ -245,6 +247,89 @@ def cmd_trace(args) -> dict:
     if trace.get("traceId"):
         result["traceId"] = trace["traceId"]
     return result
+
+
+def _fmt_log_line(r: dict) -> str:
+    """One human-readable line per structured record: wall time, level,
+    logger, message, then whatever correlation ids the record carries."""
+    import time as _time
+
+    ts = r.get("ts")
+    stamp = (
+        _time.strftime("%H:%M:%S", _time.localtime(ts))
+        + f".{int((ts % 1) * 1000):03d}"
+        if isinstance(ts, (int, float))
+        else "--:--:--"
+    )
+    line = (
+        f"{stamp} {r.get('level', '?'):7s} "
+        f"{r.get('logger', '?')}: {r.get('msg', '')}"
+    )
+    tags = [
+        f"{k}={r[k]}"
+        for k in ("source", "trace", "job", "party", "replica", "tenant")
+        if k in r
+    ]
+    if tags:
+        line += "  [" + " ".join(tags) + "]"
+    if "exc" in r:
+        line += "\n" + str(r["exc"]).rstrip()
+    return line
+
+
+def cmd_logs(args) -> dict:
+    """Print the structured log ring (GET /logs) filtered by
+    --level/--trace/--job; --follow tails it on the `since` seq cursor.
+    With --router AND --job, the federated cross-tier stream
+    (GET /fleet/jobs/{id}/logs — router + owning replica, one clock) is
+    printed instead (docs/OBSERVABILITY.md "Logging spine")."""
+    import time as _time
+
+    if args.router:
+        if not args.job:
+            raise SystemExit("--router needs --job (the routed job id)")
+        resp = requests.get(
+            f"{args.router}/fleet/jobs/{args.job}/logs",
+            params={
+                k: v
+                for k, v in (
+                    ("level", args.level), ("limit", str(args.limit)),
+                )
+                if v
+            },
+            timeout=120,
+        )
+        body = _body(resp)
+        for r in body.get("records", []):
+            print(_fmt_log_line(r))
+        if body.get("warning"):
+            print(f"warning: {body['warning']}", file=sys.stderr)
+        raise SystemExit(0)
+    params = {
+        k: v
+        for k, v in (
+            ("level", args.level),
+            ("trace", args.trace),
+            ("job", args.job),
+            ("limit", str(args.limit)),
+        )
+        if v
+    }
+    since = None
+    while True:
+        if since is not None:
+            params["since"] = str(since)
+        body = _body(requests.get(f"{args.url}/logs", params=params,
+                                  timeout=120))
+        for r in body.get("records", []):
+            print(_fmt_log_line(r), flush=True)
+        since = body.get("nextSince", since)
+        if not args.follow:
+            raise SystemExit(0)
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            raise SystemExit(0)
 
 
 def cmd_metrics(args) -> dict:
@@ -754,6 +839,28 @@ def main(argv=None) -> None:
     sp.add_argument("--out", default=None,
                     help="output path (default trace-<jobId>.json)")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser(
+        "logs",
+        help="print the server's structured log ring (GET /logs); "
+             "--follow tails it; --router + --job prints the federated "
+             "cross-tier stream",
+    )
+    sp.add_argument("--level", default=None,
+                    help="minimum level (DEBUG/INFO/WARNING/ERROR)")
+    sp.add_argument("--trace", default=None, help="filter by trace id")
+    sp.add_argument("--job", default=None, help="filter by job id")
+    sp.add_argument("--limit", type=int, default=256,
+                    help="tail cap per fetch (default 256)")
+    sp.add_argument("--follow", action="store_true",
+                    help="poll the since cursor until interrupted")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll period seconds")
+    sp.add_argument("--router", default=None,
+                    help="fleet router URL: fetch the federated "
+                         "router+replica stream from "
+                         "/fleet/jobs/{id}/logs (requires --job)")
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser(
         "metrics", help="dump the server's /metrics Prometheus text"

@@ -78,6 +78,7 @@ from ..frontend.ark_serde import proof_from_bytes
 from ..models.groth16 import verify
 from ..telemetry import buildinfo as telemetry_buildinfo
 from ..telemetry import devmem as telemetry_devmem
+from ..telemetry import logbus as telemetry_logbus
 from ..telemetry import metrics as telemetry_metrics
 from ..telemetry import profiler as telemetry_profiler
 from ..telemetry.aggregate import now_ns as _trace_now_ns
@@ -164,6 +165,11 @@ class ApiServer:
         # fleet identity (docs/FLEET.md): what this replica calls itself
         # in its /readyz capacity document and the router's replica table
         self.replica_id = self.cfg.replica_id or f"r-{uuid.uuid4().hex[:8]}"
+        # logging spine (docs/OBSERVABILITY.md "Logging spine"): install
+        # the structured ring handler and stamp records with our fleet
+        # identity; console output stays whatever the entry point chose
+        telemetry_logbus.setup(console=False)
+        telemetry_logbus.set_replica(self.replica_id)
         # SLO burn-rate sampler (docs/OBSERVABILITY.md "SLO monitoring"):
         # derives slo_burn_rate{kind}/slo_budget_remaining{kind} from the
         # job_seconds series on a timer; DG16_SLO_TARGET_S <= 0 (and no
@@ -658,6 +664,42 @@ class ApiServer:
             charset="utf-8",
         )
 
+    async def logs(self, request):
+        """GET /logs — the structured log ring, filterable by
+        ?level= (minimum), ?since= (exclusive seq cursor — the --follow
+        primitive), ?trace=, ?job=, ?logger= (prefix), ?limit= (tail
+        cap). Returns records oldest-first plus a `nextSince` cursor
+        (docs/OBSERVABILITY.md "Logging spine")."""
+        q = request.rel_url.query
+        try:
+            since = int(q["since"]) if "since" in q else None
+            limit = int(q.get("limit", "256"))
+        except ValueError:
+            return _error("since/limit must be integers", status=400)
+        level = q.get("level")
+        if level and level.upper() not in telemetry_logbus.LEVELS:
+            return _error(
+                "level must be one of DEBUG/INFO/WARNING/ERROR/CRITICAL",
+                status=400,
+            )
+        ring = telemetry_logbus.ring()
+        records = ring.query(
+            level=level,
+            since=since,
+            trace=q.get("trace") or None,
+            job=q.get("job") or None,
+            logger=q.get("logger") or None,
+            limit=limit,
+        )
+        return web.json_response({
+            "replicaId": self.replica_id,
+            "records": records,
+            "nextSince": records[-1]["seq"] if records else ring.seq,
+            # the router rebases our records onto its clock from this
+            # (same perf_counter_ns timebase ClockSync measures)
+            "nowNs": _trace_now_ns(),
+        })
+
     # -- on-demand profiling (docs/OBSERVABILITY.md "Device observatory") ----
 
     async def profile_start(self, request):
@@ -840,6 +882,7 @@ class ApiServer:
         app.router.add_get("/stats", self.stats)
         app.router.add_get("/slo", self.slo_status)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/logs", self.logs)
         app.router.add_post("/profile", self.profile_start)
         app.router.add_get("/profile", self.profile_status)
         app.router.add_get("/profile/{capture_id}", self.profile_artifact)
@@ -847,6 +890,7 @@ class ApiServer:
 
 
 def main() -> None:
+    telemetry_logbus.setup()  # console handler + ring for a real server
     port = int(os.environ.get("PORT", "8000"))
     web.run_app(ApiServer().app(), port=port)
 

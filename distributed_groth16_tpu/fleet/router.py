@@ -62,6 +62,7 @@ from aiohttp import web
 
 from ..service.journal import read_journal
 from ..telemetry import flight as _flight
+from ..telemetry import logbus as _logbus
 from ..telemetry import metrics as _tm
 from ..telemetry.aggregate import ClockSync, now_ns as _now_ns
 from ..utils.config import FleetConfig, TenantConfig
@@ -223,6 +224,10 @@ class FleetRouter:
         tenant_cfg: TenantConfig | None = None,
     ):
         self.cfg = cfg or FleetConfig.from_env()
+        # logging spine: ring handler on (idempotent — an in-process
+        # test fleet shares one ring with its replicas; the /fleet logs
+        # route filters to fleet-tier loggers to stay distinct)
+        _logbus.setup(console=False)
         self.registry = ReplicaRegistry(
             self.cfg.replicas,
             eject_threshold=self.cfg.eject_threshold,
@@ -704,7 +709,10 @@ class FleetRouter:
                 body = await resp.json()
                 status = resp.status
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
-            log.debug("dispatch %s -> %s failed: %r", job.id, replica.name, e)
+            log.debug(
+                "dispatch %s -> %s failed: %r", job.id, replica.name, e,
+                extra={"job": job.id, "trace": job.trace_id},
+            )
             self._note_replica_failure(replica, "dispatch")
             return "failed"
         if status in (200, 202):
@@ -716,6 +724,12 @@ class FleetRouter:
             replica.doc["queueDepth"] = int(replica.doc.get("queueDepth", 0)) + 1
             self._payloads.pop(job.id, None)
             _ROUTED.labels(tenant=job.tenant, priority=job.priority).inc()
+            # the router-tier breadcrumb in the job's federated log view
+            # (GET /fleet/jobs/{id}/logs — docs/OBSERVABILITY.md)
+            log.info(
+                "dispatch %s -> %s accepted", job.id, replica.name,
+                extra={"job": job.id, "trace": job.trace_id},
+            )
             return "accepted"
         if status == 429:
             hint = body.get("retryAfter")
@@ -737,6 +751,7 @@ class FleetRouter:
             log.warning(
                 "dispatch %s -> %s errored (HTTP %d): %s",
                 job.id, replica.name, status, body.get("error"),
+                extra={"job": job.id, "trace": job.trace_id},
             )
             job.error = {
                 "type": "DispatchRejected",
@@ -752,6 +767,7 @@ class FleetRouter:
         log.warning(
             "dispatch %s -> %s rejected (HTTP %d): %s",
             job.id, replica.name, status, body.get("error"),
+            extra={"job": job.id, "trace": job.trace_id},
         )
         job.error = {
             "type": "DispatchRejected",
@@ -986,6 +1002,93 @@ class FleetRouter:
             out["warning"] = warning
         return web.json_response(out)
 
+    async def fleet_job_logs(self, request):
+        """GET /fleet/jobs/{id}/logs — the job's CORRELATED log stream
+        across tiers: the router's own structured records for this trace
+        plus the owning replica's (`GET /logs?trace=`), rebased onto the
+        router's clock with the same ClockSync offset the stitched trace
+        uses. Every record gains `source` (router / replica name) and
+        `tsRouterNs`; the merge is sorted on the latter, so an operator
+        reads one causally-ordered story: admitted here, dispatched
+        there, died on party 3 (docs/OBSERVABILITY.md "Logging spine").
+        ?level= filters both sides; ?limit= caps each side's tail."""
+        job = self.jobs.get(request.match_info["job_id"])
+        if job is None:
+            return _error("unknown job id", status=404)
+        q = request.rel_url.query
+        level = q.get("level")
+        if level and level.upper() not in _logbus.LEVELS:
+            return _error(
+                "level must be one of DEBUG/INFO/WARNING/ERROR/CRITICAL",
+                status=400,
+            )
+        try:
+            limit = int(q.get("limit", "256"))
+        except ValueError:
+            return _error("limit must be an integer", status=400)
+        # the router's own records for this trace. An in-process test
+        # fleet shares ONE ring between router and replica, so keep only
+        # fleet-tier loggers here — the replica's records arrive (once)
+        # over HTTP below.
+        records = [
+            dict(r, source="router", tsRouterNs=r["tsPcNs"])
+            for r in _logbus.ring().query(
+                trace=job.trace_id, level=level, limit=limit
+            )
+            if r.get("logger", "").startswith("fleet")
+        ]
+        warning = None
+        replica = job.replica  # snapshot: handoff may null it mid-await
+        if replica is not None:
+            body = None
+            try:
+                params = {"trace": job.trace_id, "limit": str(limit)}
+                if level:
+                    params["level"] = level
+                async with self._session.get(
+                    f"{replica.url}/logs",
+                    params=params,
+                    timeout=aiohttp.ClientTimeout(total=60.0),
+                ) as resp:
+                    if resp.status == 200:
+                        body = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+                body = None
+            if body is None:
+                warning = (
+                    f"replica {replica.name} did not serve logs; "
+                    "router records only"
+                )
+                _PROXY_ERRORS.labels(route="/fleet/jobs/{job_id}/logs").inc()
+            else:
+                # rebase: ClockSync.offset_ns estimates replica_clock −
+                # router_clock over perf_counter_ns, so SUBTRACT it
+                off_ns = replica.clock.offset_ns
+                for r in body.get("records", []):
+                    if not isinstance(r, dict):
+                        continue
+                    if str(r.get("logger", "")).startswith("fleet"):
+                        # the shared-ring mirror of the dedup above: an
+                        # in-process replica echoes the router's own
+                        # records back — they're already counted
+                        continue
+                    ts = r.get("tsPcNs")
+                    if not isinstance(ts, (int, float)):
+                        continue
+                    r = dict(r)
+                    r["source"] = f"replica {replica.name}"
+                    r["tsRouterNs"] = ts - off_ns
+                    records.append(r)
+        records.sort(key=lambda r: r.get("tsRouterNs", 0))
+        out = {
+            "jobId": job.id,
+            "traceId": job.trace_id,
+            "records": records,
+        }
+        if warning is not None:
+            out["warning"] = warning
+        return web.json_response(out)
+
     async def job_cancel(self, request):
         job = self._job_or_404(request)
         if isinstance(job, web.Response):
@@ -1134,6 +1237,9 @@ class FleetRouter:
         app.router.add_get(
             "/fleet/jobs/{job_id}/trace", self.fleet_job_trace
         )
+        app.router.add_get(
+            "/fleet/jobs/{job_id}/logs", self.fleet_job_logs
+        )
         # {replica:.+}: the operand may be the config URL itself
         # (slashes and all) — `find` accepts either spelling
         app.router.add_post("/fleet/drain/{replica:.+}", self.fleet_drain)
@@ -1144,7 +1250,9 @@ class FleetRouter:
 
 
 def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    # console + ring via the one logging entry point (DG16_LOG_LEVEL /
+    # DG16_LOG_JSON) — basicConfig would bypass the structured spine
+    _logbus.setup()
     port = int(os.environ.get("PORT", "8080"))
     router = FleetRouter()
     if not router.registry.replicas:

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from ..telemetry import aggregate as _aggregate
+from ..telemetry import logbus as _logbus
 from ..telemetry.tracing import TraceBuffer, chrome_envelope
 from ..utils.timers import PhaseTimings
 
@@ -40,6 +41,11 @@ from ..utils.timers import PhaseTimings
 _PATH_RE = re.compile(r"(?:/[\w.+-]+){2,}/?")
 _BIGINT_RE = re.compile(r"\d{20,}")
 _MESSAGE_CAP = 300
+
+
+# how many of the job's own log records the status DTO carries — a tail,
+# not the firehose (the full filtered stream lives behind GET /logs)
+LOG_TAIL = 50
 
 
 def sanitize_message(msg: str) -> str:
@@ -133,6 +139,7 @@ class ProofJob:
         # and the round critical-path decomposition
         self._spans_json: str | None = None
         self._chrome_json: str | None = None
+        self._logs_json: str | None = None
         self._critical_path: dict | None = None
         self._dropped_spans = 0
         # the phase the executor is currently in (note_phase) — failure
@@ -218,6 +225,13 @@ class ProofJob:
         # Chrome export is already the merged per-job timeline — one
         # track per party — and supports a critical-path decomposition.
         self._dropped_spans = self.trace.dropped
+        # snapshot this job's slice of the structured log ring NOW — the
+        # shared ring keeps rolling after the job is terminal, and the
+        # status DTO must keep answering "what did this job log" after
+        # its records fell off (telemetry/logbus.py)
+        self._logs_json = json.dumps(
+            _logbus.ring().query(job=self.id, limit=LOG_TAIL)
+        )
         events = self.trace.events()
         self._spans_json = json.dumps(self.trace.span_tree())
         self._chrome_json = json.dumps(self._envelope(events))
@@ -311,6 +325,14 @@ class ProofJob:
                 }
             ),
         }
+        # the job's correlated log tail (docs/OBSERVABILITY.md "Logging
+        # spine"): terminal jobs serve the _finish snapshot, running jobs
+        # a live ring query keyed on the job id
+        out["logs"] = (
+            json.loads(self._logs_json)
+            if self._logs_json is not None
+            else _logbus.ring().query(job=self.id, limit=LOG_TAIL)
+        )
         if self.error is not None:
             out["error"] = self.error
         return out

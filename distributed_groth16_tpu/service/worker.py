@@ -32,7 +32,7 @@ from ..models.groth16.prove import prove_single
 from ..ops.field import fr
 from ..parallel.net import job_context, run_round_with_retries
 from ..parallel.pss import PackedSharingParams
-from ..telemetry import aggregate, devmem, tracing, transfer
+from ..telemetry import aggregate, devmem, logbus, tracing, transfer
 from ..utils.config import ServiceConfig
 from ..utils.timers import phase
 from .crs_cache import CrsCache
@@ -134,8 +134,18 @@ class ProofExecutor:
         try:
             with tracing.collect(job.trace), job_context(job.id), tracing.span(
                 "job", job=job.id, attrs=attrs,
-            ):
-                return self._run(job)
+            ), logbus.bind(tenant=job.tenant, priority=job.priority):
+                try:
+                    return self._run(job)
+                except JobCancelled:
+                    raise
+                except Exception as e:  # noqa: BLE001 — logged, re-raised
+                    # log the failure INSIDE the job's trace/log context:
+                    # the record lands in the ring carrying this job's
+                    # trace id, and its WARN+ instant event lands in the
+                    # job's own Chrome trace at the fault instant
+                    log.error("job %s failed: %s", job.id, e)
+                    raise
         finally:
             job.note_device_memory(
                 devmem.peak_delta(peak0, devmem.peak_bytes())
@@ -297,7 +307,12 @@ class WorkerPool:
             except JobCancelled:
                 job.mark_cancelled()
             except Exception as e:  # noqa: BLE001 — job-level CustomError
-                log.warning("job %s failed: %s", job.id, e)
+                # the loop thread runs outside the job's trace context —
+                # correlate explicitly via the structured-extras API
+                log.warning(
+                    "job %s failed: %s", job.id, e,
+                    extra={"job": job.id, "trace": job.trace_id},
+                )
                 job.mark_failed(e)
             else:
                 job.mark_done(result)

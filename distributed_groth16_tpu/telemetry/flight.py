@@ -118,6 +118,7 @@ class FlightRecorder:
         # device on XLA:CPU) — an OOM post-mortem must say how full the
         # device was, not just which Python frame died
         from . import devmem as _devmem
+        from . import logbus as _logbus
 
         record = {
             "trigger": trigger,
@@ -130,6 +131,10 @@ class FlightRecorder:
             "spans": spans,
             "deviceMemory": _devmem.snapshot(),
             "metrics": _tm.registry().snapshot(),
+            # the structured log tail (telemetry/logbus.py): what the
+            # process SAID in the lead-up, correlated by trace/job ids —
+            # empty when the spine never saw a record
+            "logs": _logbus.tail(256),
         }
         name = f"flight-p{party if party is not None else 'x'}-" \
                f"{os.getpid()}-{seq}-{trigger}.json"

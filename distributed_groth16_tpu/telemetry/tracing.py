@@ -139,7 +139,7 @@ class TraceBuffer:
         """Nest finished spans by parent id — the `metrics.spans` block of
         GET /jobs/{id}. A span whose parent was dropped (overflow) or is
         still open becomes a root."""
-        evs = self.events()
+        evs = [e for e in self.events() if e.get("ph", "X") == "X"]
         nodes: dict[int, dict] = {}
         for ev in evs:
             args = ev.get("args", {})
@@ -198,7 +198,7 @@ def _tid() -> int:
 class Span:
     __slots__ = (
         "name", "bufs", "timings", "pid", "attrs",
-        "id", "parent_id", "_token", "t0", "annotation",
+        "id", "parent_id", "parent", "_token", "t0", "annotation",
     )
 
     def __init__(self, name, bufs, timings, pid, attrs, annotation=None):
@@ -209,6 +209,11 @@ class Span:
         self.attrs = attrs
         self.id = next(_IDS)
         self.parent_id = 0
+        # live parent reference (not just the id): telemetry/logbus.py
+        # walks the open chain at log time to find trace/job attrs set on
+        # an enclosing span. Spans are short-lived scopes, so the extra
+        # reference does not extend any object's lifetime meaningfully.
+        self.parent = None
         self._token = None
         self.t0 = 0.0
         self.annotation = annotation
@@ -216,6 +221,7 @@ class Span:
     def __enter__(self):
         parent = _CURRENT.get()
         if parent is not None:
+            self.parent = parent
             self.parent_id = parent.id
             if self.pid is None:
                 self.pid = parent.pid
@@ -308,6 +314,62 @@ def span(
         except Exception:  # noqa: BLE001 — a capture teardown race is benign
             annotation = None
     return Span(name, bufs, timings, party, a, annotation)
+
+
+def current() -> "Span | None":
+    """The innermost OPEN span in this context (None when idle) — the
+    ambient-enrichment hook for telemetry/logbus.py, which walks the
+    `.parent` chain for trace/job attrs at log time."""
+    return _CURRENT.get()
+
+
+def instant(
+    name: str,
+    *,
+    args: dict | None = None,
+    pid: int | None = None,
+) -> bool:
+    """Record one Chrome instant event (`"ph": "i"`) into every buffer a
+    span would record into right now — how logbus paints WARN+ records
+    onto the job timeline. Returns False (and allocates nothing beyond
+    the contextvar read) when no buffer is active, preserving the
+    zero-overhead-when-idle contract. `dur` is 0 so the aggregation
+    plane's numeric ts/dur filter ships these cross-party instead of
+    dropping them; span_tree() skips non-"X" phases."""
+    b = _BUFFER.get()
+    g = _global_buffer
+    x = _extra_sinks
+    if b is None and g is None and not x:
+        return False
+    if not x:
+        if b is None:
+            bufs = (g,)
+        elif g is None or g is b:
+            bufs = (b,)
+        else:
+            bufs = (b, g)
+    else:
+        seen: list = []
+        for s in (b, g) + x:
+            if s is not None and all(s is not t for t in seen):
+                seen.append(s)
+        bufs = tuple(seen)
+    cur = _CURRENT.get()
+    if pid is None and cur is not None:
+        pid = cur.pid
+    ev = {
+        "name": name,
+        "ph": "i",
+        "s": "g",
+        "ts": round(time.perf_counter() * 1e6, 1),
+        "dur": 0.0,
+        "pid": pid if pid is not None else 0,
+        "tid": _tid(),
+        "args": dict(args) if args else {},
+    }
+    for buf in bufs:
+        buf.add(ev)
+    return True
 
 
 def active() -> bool:

@@ -56,6 +56,12 @@ KNOBS: dict[str, str] = {
     "DG16_AGG": "star-wide trace aggregation plane (default off)",
     "DG16_FLIGHT_DIR": "flight-recorder post-mortem directory",
     "DG16_FLIGHT_ARTIFACT_DIR": "chaos-suite flight-dump dir (CI upload)",
+    # logging spine (docs/OBSERVABILITY.md "Logging spine")
+    "DG16_LOG_RING": "structured log ring size, records",
+    "DG16_LOG_LEVEL": "package logger level (default INFO)",
+    "DG16_LOG_JSON": "console handler emits JSON lines",
+    "DG16_LOG_STORM_BURST": "per-template records before suppression",
+    "DG16_LOG_STORM_RATE": "suppressed-template refill, records/sec, <=0 off",
     # performance observatory (docs/PERF.md, docs/OBSERVABILITY.md)
     "DG16_PERF_REPS": "benchgate warm reps per kernel case",
     "DG16_PERF_REL_THRESHOLD": "benchgate relative slowdown gate",

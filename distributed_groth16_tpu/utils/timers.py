@@ -23,6 +23,7 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from ..telemetry import logbus as _logbus
 from ..telemetry import metrics as _metrics
 from ..telemetry import tracing as _tracing
 from . import config as _config
@@ -41,17 +42,24 @@ def trace_enabled() -> bool:
 
 
 def _emit(msg: str, *args) -> None:
-    """Exactly-once INFO log, falling back to stderr print when logging is
-    unconfigured (DG16_TRACE should always be visible, config or not).
+    """Exactly-once INFO log; when logging is unconfigured everywhere,
+    install the logbus console handler instead of a raw print so
+    DG16_TRACE output stays visible AND lands in the structured ring.
 
-    When BOTH the package logger and the root logger have handlers,
-    `log.info` would print twice (once via the package handlers, once via
-    propagation to root) — in that case the package handlers win and the
-    record is handed to them directly, bypassing propagation. If every
-    package handler rejects the record (level), fall through to the normal
-    path: they reject it there too and root prints it once."""
+    When BOTH a package CONSOLE handler and the root logger's handlers
+    would print, `log.info` would print twice (once via the package
+    handlers, once via propagation to root) — in that case the package
+    handlers win and the record is handed to them directly, bypassing
+    propagation. The spine's ring handler does not count: it never
+    writes a terminal, so ring + root is not a double print. If every
+    package handler rejects the record (level), fall through to the
+    normal path: they reject it there too and root prints it once."""
     root = logging.getLogger()
-    if log.handlers and log.propagate and root.handlers:
+    printers = [
+        h for h in log.handlers
+        if not isinstance(h, _logbus.LogBusHandler)
+    ]
+    if printers and log.propagate and root.handlers:
         if not log.isEnabledFor(logging.INFO):
             return
         record = log.makeRecord(
@@ -59,17 +67,19 @@ def _emit(msg: str, *args) -> None:
         )
         if not log.filter(record):
             return
-        eligible = [h for h in log.handlers if record.levelno >= h.level]
-        if eligible:
-            for h in eligible:
-                h.handle(record)
+        # the double-print question is decided by PRINTERS only: if none
+        # accepts the record, fall through so root prints it once (the
+        # ring handler would swallow it here and drop it from the console)
+        if any(record.levelno >= h.level for h in printers):
+            for h in log.handlers:
+                if record.levelno >= h.level:
+                    h.handle(record)
             return
     if log.handlers or root.handlers:
         log.info(msg, *args)
         return
-    import sys
-
-    print(msg % args, file=sys.stderr, flush=True)
+    _logbus.setup(console=True)
+    log.info(msg, *args)
 
 
 @dataclass

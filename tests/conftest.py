@@ -54,6 +54,39 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On a test failure, dump the structured log ring next to the
+    flight-recorder post-mortems (docs/OBSERVABILITY.md "Logging spine"):
+    CI uploads DG16_FLIGHT_ARTIFACT_DIR, so the last 256 correlated
+    records — trace/job/party-enriched — ride along with every red run.
+    Free when the var is unset or no ring was ever created."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when != "call" or not rep.failed:
+        return
+    artifact_dir = os.environ.get("DG16_FLIGHT_ARTIFACT_DIR")
+    if not artifact_dir:
+        return
+    from distributed_groth16_tpu.telemetry import logbus
+
+    records = logbus.tail(256)
+    if not records:
+        return
+    import json
+    import re
+
+    safe = re.sub(r"[^A-Za-z0-9_.-]+", "_", item.nodeid)[-100:]
+    try:
+        os.makedirs(artifact_dir, exist_ok=True)
+        with open(
+            os.path.join(artifact_dir, f"log-ring-{safe}.json"), "w"
+        ) as f:
+            json.dump({"test": item.nodeid, "records": records}, f)
+    except (OSError, TypeError, ValueError):
+        pass  # an artifact must never turn one failure into two
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _drop_live_executables_between_modules():
     """XLA:CPU segfaults inside backend_compile_and_load once enough

@@ -831,6 +831,93 @@ def test_trace_id_survives_journal_backed_handoff(circuit, tmp_path):
     asyncio.run(run())
 
 
+def test_fleet_job_logs_federates_router_and_replica_records(
+    circuit, tmp_path
+):
+    """The logging-spine cross-tier case (docs/OBSERVABILITY.md "Logging
+    spine"): a routed MPC job dies on the replica, and ONE query —
+    `GET /fleet/jobs/{id}/logs` — returns the whole story under the
+    router-minted trace id: the router's dispatch breadcrumb AND the
+    replica's ERROR, every record rebased onto the router clock."""
+    root, cid, _, _ = circuit
+    cs = mult_chain_circuit(9, 7)
+    r1cs, z = cs.finish()
+    bad = list(z)
+    bad[-1] += 1  # breaks the last constraint -> witness-phase failure
+    bad_wtns = write_wtns(bad)
+
+    async def run():
+        replica = await _start_replica(
+            root, tmp_path / "jlogs", "r-logs", workers=1
+        )
+        router = FleetRouter(
+            FleetConfig(
+                replicas=((replica.url, str(tmp_path / "jlogs")),),
+                poll_s=0.2,
+            )
+        )
+        client = TestClient(TestServer(router.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/jobs/prove",
+                data={"circuit_id": cid, "witness_file": bad_wtns,
+                      "mpc": "1"},
+                headers={"X-DG16-Tenant": "acme"},
+            )
+            body = await resp.json()
+            assert resp.status == 202, body
+            jid, trace = body["jobId"], body["traceId"]
+            status = await _poll_terminal(client, jid)
+            assert status["state"] == "FAILED", status
+
+            resp = await client.get(f"/fleet/jobs/{jid}/logs")
+            doc = await resp.json()
+            assert resp.status == 200, doc
+            assert doc["jobId"] == jid and doc["traceId"] == trace
+            assert "warning" not in doc, doc
+            recs = doc["records"]
+            sources = {r["source"] for r in recs}
+            assert "router" in sources, recs
+            assert "replica r-logs" in sources, recs
+            # every router-tier record is fleet-logged; the replica ERROR
+            # carries the full correlation tuple
+            for r in recs:
+                if r["source"] == "router":
+                    assert r["logger"].startswith("fleet")
+            errors = [r for r in recs if r["level"] == "ERROR"]
+            assert errors, recs
+            err = errors[0]
+            assert err["source"] == "replica r-logs"
+            assert err["trace"] == trace
+            assert err["job"] == jid
+            assert err["replica"] == "r-logs"
+            assert err["tenant"] == "acme"
+            # the merge is one causally-ordered story on the router clock
+            ts = [r["tsRouterNs"] for r in recs]
+            assert ts == sorted(ts)
+
+            # ?level= filters both tiers
+            resp = await client.get(
+                f"/fleet/jobs/{jid}/logs", params={"level": "ERROR"}
+            )
+            doc = await resp.json()
+            assert all(r["levelNo"] >= 40 for r in doc["records"])
+            assert any(r["trace"] == trace for r in doc["records"])
+
+            resp = await client.get("/fleet/jobs/nope/logs")
+            assert resp.status == 404
+            resp = await client.get(
+                f"/fleet/jobs/{jid}/logs", params={"level": "LOUD"}
+            )
+            assert resp.status == 400
+        finally:
+            await client.close()
+            await replica.cleanup()
+
+    asyncio.run(run())
+
+
 # -- router /metrics + front-door middleware ----------------------------------
 
 

@@ -61,9 +61,10 @@ def rules_of(findings):
 # -- framework ---------------------------------------------------------------
 
 
-def test_all_seven_rules_registered():
+def test_all_eight_rules_registered():
     assert sorted(all_rules()) == [
         "DG101", "DG102", "DG103", "DG104", "DG105", "DG106", "DG107",
+        "DG108",
     ]
 
 
@@ -211,6 +212,76 @@ def test_dg102_clean_passes(tmp_path):
                 return witness_calculator.run(data)
             """,
     }, select="DG102")
+    assert findings == []
+
+
+def test_dg102_catches_logbus_bind_extras(tmp_path):
+    findings, _ = lint(tmp_path, {
+        "pkg/mod.py": """
+            from ..telemetry import logbus
+
+            def f(witness_digest):
+                with logbus.bind(tenant="t", w=witness_digest):
+                    pass
+            """,
+    }, select="DG102")
+    assert rules_of(findings) == ["DG102"]
+    assert "witness_digest" in findings[0].message
+    assert "log" in findings[0].message
+
+
+# -- DG108 print discipline ---------------------------------------------------
+
+
+def test_dg108_catches_package_print(tmp_path):
+    findings, _ = lint(tmp_path, {
+        "pkg/mod.py": """
+            def helper(x):
+                print("value", x)
+            """,
+    }, select="DG108")
+    assert rules_of(findings) == ["DG108"]
+    assert "structured log ring" in findings[0].message
+
+
+def test_dg108_allows_cli_surfaces_and_main(tmp_path):
+    findings, _ = lint(tmp_path, {
+        "pkg/cli.py": 'print("usage")\n',
+        "pkg/__main__.py": 'print("hi")\n',
+        "pkg/tool.py": """
+            def main(argv=None):
+                print("report")
+                def nested():
+                    print("still CLI output")
+                return nested
+            """,
+    }, select="DG108")
+    assert findings == []
+
+
+def test_dg108_suppression_holds(tmp_path):
+    findings, suppressed = lint(tmp_path, {
+        "pkg/mod.py": """
+            def write(payload, path):
+                if path == "-":
+                    print(payload)  # dg16lint: disable=DG108
+            """,
+    }, select="DG108")
+    assert findings == []
+    assert suppressed == 1
+
+
+def test_dg108_clean_passes(tmp_path):
+    findings, _ = lint(tmp_path, {
+        "pkg/mod.py": """
+            import logging
+
+            log = logging.getLogger(__name__)
+
+            def helper(x):
+                log.info("value %s", x)
+            """,
+    }, select="DG108")
     assert findings == []
 
 
