@@ -15,6 +15,10 @@ Usage:
       --witness w.wtns [--l 2]
   python -m distributed_groth16_tpu.api.cli verify --circuit-id ID \
       --proof proof.bin --public 33 [--public ...]
+  python -m distributed_groth16_tpu.api.cli verify --batch --circuit-id ID \
+      proof1.bin:33,44 proof2.bin:55 [...]
+  python -m distributed_groth16_tpu.api.cli aggregate ID \
+      proof1.bin:33 proof2.bin:55 [--out bundle.json]
   python -m distributed_groth16_tpu.api.cli job submit --circuit-id ID \
       --witness w.wtns [--mpc] [--l 2]
   python -m distributed_groth16_tpu.api.cli job status --job-id JOB
@@ -111,6 +115,15 @@ def cmd_mpc_prove(args) -> dict:
 
 
 def cmd_verify(args) -> dict:
+    if args.batch:
+        if not args.proofs:
+            raise SystemExit(
+                "--batch needs proof specs: verify --batch "
+                "--circuit-id ID proof.bin:33,44 [...]"
+            )
+        return _proofs_job(args, "verify", args.proofs)
+    if not args.proof:
+        raise SystemExit("--proof is required (or use --batch with specs)")
     proof = list(open(args.proof, "rb").read())
     return _body(
         requests.post(
@@ -123,6 +136,58 @@ def cmd_verify(args) -> dict:
             timeout=600,
         )
     )
+
+
+def _parse_proof_spec(spec: str) -> dict:
+    """`path[:pub,pub,...]` -> one proofs_file item. The publics ride
+    after the colon so a batch line stays one token per proof."""
+    path, _, pubs = spec.partition(":")
+    publics = [s.strip() for s in pubs.split(",") if s.strip()]
+    return {
+        "proof": list(open(path, "rb").read()),
+        "publicInputs": publics,
+    }
+
+
+def _proofs_job(args, kind: str, specs: list) -> dict:
+    """Submit N proofs as ONE kind=verify|aggregate job (docs/VERIFY.md)
+    and follow it to a terminal state — the whole batch folds into a
+    single multi-pairing server-side."""
+    import time as _time
+
+    items = [_parse_proof_spec(s) for s in specs]
+    fields = {
+        "circuit_id": args.circuit_id.encode(),
+        "proofs_file": json.dumps(items).encode(),
+    }
+    body = _post_multipart(f"{args.url}/jobs/{kind}", fields)
+    job_id = body["jobId"]
+    while True:
+        status = _job_status(args.url, job_id)
+        state = status.get("state")
+        if state in ("DONE", "FAILED", "CANCELLED"):
+            break
+        _time.sleep(args.interval)
+    if state != "DONE":
+        # an invalid proof is a FAILED job whose error names the bad
+        # indices (InvalidProofError) — surface that, not a traceback
+        return status
+    result = _body(
+        requests.get(f"{args.url}/jobs/{job_id}/result", timeout=600)
+    )
+    out = getattr(args, "out", None)
+    if out and "bundle" in result:
+        with open(out, "w") as f:
+            json.dump(result["bundle"], f, indent=2)
+        result["bundleOut"] = out
+    return result
+
+
+def cmd_aggregate(args) -> dict:
+    """`aggregate CIRCUIT proof.bin:33,44 [...]` — verify N proofs and
+    compress them into one RLC-folded bundle attestation, re-checkable
+    offline by a single multi-pairing (docs/VERIFY.md)."""
+    return _proofs_job(args, "aggregate", args.proofs)
 
 
 def cmd_job_submit(args) -> dict:
@@ -533,6 +598,11 @@ def format_fleet_top(stats: dict, metrics_text: str) -> str:
         if fam is not None and fam.samples:
             footer.append(f"{label}={_fmt_cell(fam.samples[0][2])}")
     footer.append(f"pending={stats.get('pending', 0)}")
+    # per-kind depth: how much prove vs verify work waits at the front
+    # door (docs/VERIFY.md)
+    by_kind = stats.get("pendingByKind", {})
+    for kind in sorted(by_kind):
+        footer.append(f"pending[{kind}]={by_kind[kind]}")
     footer.append(f"handoffs={stats.get('handoffs', 0)}")
     lines.append("  ".join(footer))
     return "\n".join(lines)
@@ -970,11 +1040,40 @@ def main(argv=None) -> None:
     sp = psub.add_parser("status", help="capture history (GET /profile)")
     sp.set_defaults(fn=cmd_profile_status)
 
-    sp = sub.add_parser("verify")
+    sp = sub.add_parser(
+        "verify",
+        help="single proof via POST /verify_proof, or --batch to fold N "
+             "proofs into one kind=verify job (docs/VERIFY.md)",
+    )
     sp.add_argument("--circuit-id", required=True)
-    sp.add_argument("--proof", required=True)
-    sp.add_argument("--public", action="append", default=[], type=int)
+    sp.add_argument("--proof", default=None,
+                    help="single-proof mode: ark-compressed proof file")
+    sp.add_argument("--public", action="append", default=[], type=int,
+                    help="single-proof mode public input (repeatable)")
+    sp.add_argument("--batch", action="store_true",
+                    help="submit the positional specs as ONE batched "
+                         "verify job")
+    sp.add_argument("proofs", nargs="*", metavar="PROOF[:PUB,PUB]",
+                    help="--batch proof specs: path, optionally "
+                         "':'-joined comma-separated public inputs")
+    sp.add_argument("--interval", type=float, default=1.0,
+                    help="--batch poll period seconds")
     sp.set_defaults(fn=cmd_verify)
+
+    sp = sub.add_parser(
+        "aggregate",
+        help="verify N proofs and emit one RLC-folded bundle "
+             "attestation (POST /jobs/aggregate, docs/VERIFY.md)",
+    )
+    sp.add_argument("circuit_id", help="circuit id the proofs belong to")
+    sp.add_argument("proofs", nargs="+", metavar="PROOF[:PUB,PUB]",
+                    help="proof specs: path, optionally ':'-joined "
+                         "comma-separated public inputs")
+    sp.add_argument("--out", default=None,
+                    help="write the bundle JSON here")
+    sp.add_argument("--interval", type=float, default=1.0,
+                    help="poll period seconds")
+    sp.set_defaults(fn=cmd_aggregate)
 
     sp = sub.add_parser(
         "export-eth",

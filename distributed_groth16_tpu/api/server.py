@@ -9,7 +9,12 @@ Legacy routes and DTO field names mirror the reference exactly:
                                     witness_file (.wtns)
   POST /create_proof_with_naive_mpc same fields (+ l)
   POST /verify_proof                JSON: circuitId, proof (bytes),
-                                    publicInputs ([str])
+                                    publicInputs ([str]) — now a
+                                    submit-and-await wrapper over a
+                                    kind="verify" job (docs/VERIFY.md):
+                                    malformed payloads get a typed 400
+                                    {"error": {type, message, phase}},
+                                    an invalid proof is isValid=false 200
   GET  /get_circuit_files/{id}
 
 Jobs API (the async path — every proof, including the legacy synchronous
@@ -17,6 +22,15 @@ routes above, funnels through one queue + bounded worker pool):
 
   POST   /jobs/prove      same multipart fields + optional `mpc` flag;
                           returns {jobId, state} immediately
+  POST   /jobs/verify     multipart: circuit_id, proofs_file (JSON array
+                          of {proof, publicInputs}); a batched-RLC
+                          verification job — same 202 DTO, same queue,
+                          bucketer admission and journal as prove
+                          (docs/VERIFY.md)
+  POST   /jobs/aggregate  same fields; verifies then compresses the
+                          batch into one RLC-folded bundle attestation
+                          (result carries `bundle`, re-checkable by a
+                          single multi-pairing)
   GET    /jobs/{id}       status DTO (state, timestamps, phases, error,
                           span tree + critical path under `metrics`)
   GET    /jobs/{id}/trace Chrome trace-event JSON of the job's merged
@@ -66,6 +80,7 @@ blobs (frontend/ark_serde.py), JSON-encoded as byte lists.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import signal
@@ -74,8 +89,7 @@ import uuid
 
 from aiohttp import web
 
-from ..frontend.ark_serde import proof_from_bytes
-from ..models.groth16 import verify
+from ..service.jobs import error_dto
 from ..telemetry import buildinfo as telemetry_buildinfo
 from ..telemetry import devmem as telemetry_devmem
 from ..telemetry import logbus as telemetry_logbus
@@ -107,7 +121,7 @@ log = logging.getLogger(__name__)
 
 MAX_BODY = 100 * 1024 * 1024  # 100 MB limit (main.rs:801)
 
-_JOB_FIELDS = ("witness_file", "input_file")
+_JOB_FIELDS = ("witness_file", "input_file", "proofs_file")
 
 _DRAINING = telemetry_metrics.registry().gauge(
     "service_draining",
@@ -410,23 +424,56 @@ class ApiServer:
         )
 
     async def verify_proof(self, request):
+        """Legacy single-proof verification — now a submit-and-await
+        wrapper over a kind="verify" job (docs/VERIFY.md), so the check
+        rides the same queue, metrics (job_seconds{kind="verify"},
+        jobs_finished_total) and scheduler batching as every other job.
+        A malformed payload is a typed 400 with the sanitized error DTO
+        ({type, message, phase}), never a 500 traceback; an invalid but
+        well-formed proof is a definite verdict: isValid=false, HTTP 200."""
         t0 = time.time()
         try:
             body = await request.json()
-            circuit_id = body["circuitId"]
-            proof = proof_from_bytes(bytes(body["proof"]))
-            publics = [int(x) for x in body["publicInputs"]]
-            _, pk = await asyncio.to_thread(self.store.load, circuit_id)
-            ok = await asyncio.to_thread(verify, pk.vk, proof, publics)
+            circuit_id = str(body["circuitId"])
+            proof_bytes = bytes(bytearray(body["proof"]))
+            publics = [str(int(x)) for x in body["publicInputs"]]
+        except Exception as e:  # noqa: BLE001 — malformed request body
+            return web.json_response(
+                {"error": error_dto(e, phase="parse")}, status=400
+            )
+        payload = json.dumps(
+            [{"proof": list(proof_bytes), "publicInputs": publics}]
+        ).encode()
+        try:
+            job = await self._submit(
+                {"circuit_id": circuit_id.encode(), "proofs_file": payload},
+                "verify",
+                request=request,
+            )
+            await job.wait()
+        except QueueFullError as e:
+            return _busy(e)
+        except DrainingError as e:
+            return _error(str(e), status=503)
         except Exception as e:  # noqa: BLE001
             return _error(str(e))
+        err = job.error or {}
+        if job.state is JobState.DONE:
+            is_valid = True
+        elif err.get("type") == "InvalidProofError":
+            is_valid = False  # definite verdict, not an error
+        elif err.get("type") in ("ValueError", "KeyError", "TypeError"):
+            # payload the executor could not even parse: client error
+            return web.json_response({"error": err}, status=400)
+        else:
+            return _error(err.get("message", job.state.value))
         return web.json_response(
             {
                 "circuitId": circuit_id,
-                "publicInputs": [str(x) for x in publics],
+                "publicInputs": publics,
                 "verifierKey": None,
-                "proof": list(body["proof"]),
-                "isValid": bool(ok),
+                "proof": list(proof_bytes),
+                "isValid": is_valid,
                 "timeTaken": _millis(t0),
                 "remarks": None,
             }
@@ -474,6 +521,47 @@ class ApiServer:
             status=202,
         )
 
+    async def _jobs_submit_batchable(self, request, kind: str):
+        """POST /jobs/verify and /jobs/aggregate — the 202 submission
+        path for the verification plane (docs/VERIFY.md). Unlike the
+        prove route, a malformed submission here is a typed 400 with the
+        sanitized error DTO — the verify plane's contract everywhere."""
+        try:
+            fields = await _read_multipart(request)
+            if "circuit_id" not in fields:
+                raise ValueError("need a circuit_id field")
+            if "proofs_file" not in fields:
+                raise ValueError(
+                    "need a proofs_file field "
+                    "(JSON array of {proof, publicInputs})"
+                )
+            job = await self._submit(fields, kind, request=request)
+        except QueueFullError as e:
+            return _busy(e)
+        except DrainingError as e:
+            return _error(str(e), status=503)
+        except (KeyError, ValueError, TypeError) as e:
+            return web.json_response(
+                {"error": error_dto(e, phase="submit")}, status=400
+            )
+        except Exception as e:  # noqa: BLE001
+            return _error(str(e))
+        return web.json_response(
+            {
+                "jobId": job.id,
+                "circuitId": job.circuit_id,
+                "state": job.state.value,
+                "queueDepth": self.queue.stats()["queueDepth"],
+            },
+            status=202,
+        )
+
+    async def jobs_verify(self, request):
+        return await self._jobs_submit_batchable(request, "verify")
+
+    async def jobs_aggregate(self, request):
+        return await self._jobs_submit_batchable(request, "aggregate")
+
     def _job_or_404(self, request) -> ProofJob | web.Response:
         job = self.queue.jobs.get(request.match_info["job_id"])
         if job is None:
@@ -509,16 +597,17 @@ class ApiServer:
         if job.state is not JobState.DONE:
             return _error(f"job not finished (state {job.state.value})", 409)
         rt = job.runtime_s or 0.0
-        return web.json_response(
-            {
-                "jobId": job.id,
-                "circuitId": job.circuit_id,
-                "proof": job.result["proof"],
-                "phases": job.result["phases"],
-                "timeTaken": int(rt * 1000),
-                "remarks": None,
-            }
-        )
+        body = {
+            "jobId": job.id,
+            "circuitId": job.circuit_id,
+            "timeTaken": int(rt * 1000),
+            "remarks": None,
+        }
+        # prove-kind results carry {proof, phases}; verify/aggregate
+        # results carry {count, verdicts, pairingsSaved, bundle?, phases}
+        # — return whichever shape the job produced
+        body.update(job.result or {})
+        return web.json_response(body)
 
     async def job_cancel(self, request):
         job = self.queue.cancel(request.match_info["job_id"])
@@ -630,6 +719,7 @@ class ApiServer:
             {
                 "queue": self.queue.stats(),
                 "crsCache": self.crs_cache.stats(),
+                "verifierCache": self.executor.verifier.pvk_cache.stats(),
                 "journal": (
                     self.journal.stats()
                     if self.journal is not None
@@ -872,6 +962,8 @@ class ApiServer:
             "/get_circuit_files/{circuit_id}", self.get_circuit_files
         )
         app.router.add_post("/jobs/prove", self.jobs_prove)
+        app.router.add_post("/jobs/verify", self.jobs_verify)
+        app.router.add_post("/jobs/aggregate", self.jobs_aggregate)
         app.router.add_get("/jobs/{job_id}", self.job_status)
         app.router.add_get("/jobs/{job_id}/trace", self.job_trace)
         app.router.add_get("/jobs/{job_id}/result", self.job_result)

@@ -7,7 +7,10 @@ it admits, schedules, dispatches, proxies, and hands off. Each replica
 is a full PR 7 crash-safe ApiServer with its own device inventory and
 durable journal; all replicas share the circuit store.
 
-Request path for `POST /jobs/prove`:
+Request path for `POST /jobs/prove` (and its verification-plane siblings
+`POST /jobs/verify` / `POST /jobs/aggregate` — same admission, same
+weighted-fair queue, dispatched to the matching replica endpoint;
+docs/VERIFY.md):
 
   1. tenant identity from the `X-DG16-Tenant` header (absent ->
      "anonymous") and a priority class from `X-DG16-Priority` /
@@ -695,9 +698,16 @@ class FleetRouter:
         for name, value in fields.items():
             form.add_field(name, value, filename=name)
         form.add_field("job_id", job.id)
+        # kind picks the replica endpoint: the verification plane has
+        # its own submission routes (docs/VERIFY.md); prove/mpc_prove
+        # share /jobs/prove (the mpc flag rides in the fields)
+        endpoint = {
+            "verify": "/jobs/verify",
+            "aggregate": "/jobs/aggregate",
+        }.get(job.kind, "/jobs/prove")
         try:
             async with self._session.post(
-                f"{replica.url}/jobs/prove",
+                f"{replica.url}{endpoint}",
                 data=form,
                 headers={
                     "X-DG16-Tenant": job.tenant,
@@ -780,6 +790,20 @@ class FleetRouter:
     # -- HTTP handlers --------------------------------------------------------
 
     async def jobs_prove(self, request):
+        return await self._jobs_submit(request, None)
+
+    async def jobs_verify(self, request):
+        return await self._jobs_submit(request, "verify")
+
+    async def jobs_aggregate(self, request):
+        return await self._jobs_submit(request, "aggregate")
+
+    async def _jobs_submit(self, request, kind: str | None):
+        """Front-door admission for every job kind. kind=None is the
+        prove route (mpc flag picks prove/mpc_prove); "verify" and
+        "aggregate" are the verification plane (docs/VERIFY.md) — same
+        tenant buckets, same weighted-fair queue, same idempotent
+        dispatch; only the replica endpoint differs (by job.kind)."""
         t_req0 = time.perf_counter()
         tenant = request.headers.get("X-DG16-Tenant", "").strip() \
             or DEFAULT_TENANT
@@ -797,6 +821,8 @@ class FleetRouter:
             return _error("fleet router is draining", status=503)
         if "circuit_id" not in fields:
             return _error("circuit_id field is required")
+        if kind in ("verify", "aggregate") and "proofs_file" not in fields:
+            return _error("proofs_file field is required", status=400)
         # decode BEFORE admit(): a slot charged for a submission that
         # then 500s on bad bytes would never be released (quota leak)
         try:
@@ -804,6 +830,8 @@ class FleetRouter:
             mpc = fields.get("mpc", b"").decode().lower() in ("1", "true", "yes")
         except UnicodeDecodeError:
             return _error("circuit_id / mpc fields must be UTF-8")
+        if kind is None:
+            kind = "mpc_prove" if mpc else "prove"
         if len(self.queue) >= self.cfg.pending_bound:
             self.admission.note_rejected(tenant, "backlog")
             return _busy(
@@ -827,7 +855,7 @@ class FleetRouter:
             tenant=tenant,
             priority=priority,
             circuit_id=circuit_id,
-            kind="mpc_prove" if mpc else "prove",
+            kind=kind,
             # the end-to-end trace context is born here, next to the
             # idempotent job id: every router span, replica service
             # span, and MPC-party span downstream carries it
@@ -1104,6 +1132,15 @@ class FleetRouter:
 
     # -- fleet control plane --------------------------------------------------
 
+    def _pending_by_kind(self) -> dict[str, int]:
+        """Undispatched depth per job kind — how much prove vs verify
+        work waits at the front door (`fleet top`, docs/VERIFY.md)."""
+        out: dict[str, int] = {}
+        for j in self.jobs.values():
+            if j.state == "PENDING" and not j.cancelled:
+                out[j.kind] = out.get(j.kind, 0) + 1
+        return out
+
     async def fleet_stats(self, request):
         return web.json_response(
             {
@@ -1111,6 +1148,7 @@ class FleetRouter:
                 "tenants": self.admission.stats(),
                 "pending": len(self.queue),
                 "pendingByClass": self.queue.occupancy(),
+                "pendingByKind": self._pending_by_kind(),
                 "weights": dict(self.cfg.weights),
                 "handoffs": self.handoffs,
                 "jobsTracked": len(self.jobs),
@@ -1228,6 +1266,8 @@ class FleetRouter:
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         app.router.add_post("/jobs/prove", self.jobs_prove)
+        app.router.add_post("/jobs/verify", self.jobs_verify)
+        app.router.add_post("/jobs/aggregate", self.jobs_aggregate)
         app.router.add_get("/jobs/{job_id}", self.job_status)
         app.router.add_get("/jobs/{job_id}/result", self.job_result)
         app.router.add_get("/jobs/{job_id}/trace", self.job_trace)

@@ -12,6 +12,13 @@ from ...ops.pairing import pairing_check
 from .keys import Proof, VerifyingKey
 
 
+# below this many public inputs the 256-bit ladder per input is cheaper
+# than warming ops/fixedbase.py's per-base windowed tables; at or above
+# it the tables amortize (gamma_abc bases are fixed per circuit, so every
+# later verification of the circuit rides the warm tables for free)
+_FIXEDBASE_MIN_INPUTS = 8
+
+
 def prepare_inputs(vk: VerifyingKey, public_inputs: list[int]):
     """L_pub = gamma_abc[0] + sum_i x_i * gamma_abc[i+1]."""
     if len(public_inputs) + 1 != len(vk.gamma_abc_g1):
@@ -19,6 +26,13 @@ def prepare_inputs(vk: VerifyingKey, public_inputs: list[int]):
             f"{len(public_inputs)} public inputs for "
             f"{len(vk.gamma_abc_g1) - 1} instance wires"
         )
+    if len(public_inputs) >= _FIXEDBASE_MIN_INPUTS:
+        from ...ops.fixedbase import host_windowed_mul
+
+        acc = vk.gamma_abc_g1[0]
+        for x, pt in zip(public_inputs, vk.gamma_abc_g1[1:]):
+            acc = rm.G1.add(acc, host_windowed_mul("g1", pt, x))
+        return acc
     acc = vk.gamma_abc_g1[0]
     for x, pt in zip(public_inputs, vk.gamma_abc_g1[1:]):
         acc = rm.G1.add(acc, rm.G1.scalar_mul(pt, x))

@@ -79,6 +79,55 @@ def _fixed_base_jit(curve: CurvePoints, table, scalars_std):
     return jax.lax.fori_loop(0, N_WINDOWS, body, acc0)
 
 
+# -- host-side windowed mul for arbitrary fixed bases ------------------------
+# The verifier's prepare_inputs fallback (models/groth16/verify.py): each
+# gamma_abc base is fixed per circuit and re-multiplied on every
+# verification, so the same table idea pays on pure host bigint math. A
+# narrower window keeps the one-time table build cheap: c=4 costs
+# 64 x 14 = 896 adds to build and <= 63 adds + 63 doublings-equivalent
+# gathers per mul, vs ~384 adds/doubles for one 256-bit ladder — the
+# table wins from the third multiplication on a base onward.
+
+_HOST_WINDOW_C = 4
+_HOST_N_WINDOWS = 256 // _HOST_WINDOW_C
+
+
+@functools.lru_cache(maxsize=256)
+def _host_mul_table(which: str, base_affine):
+    """(W, 2^c) affine host rows for ANY base: row w holds
+    d * 2^(c*w) * B. Cached per (group, base) — affine points are nested
+    int tuples, hence hashable."""
+    host_ops = rm.G1 if which == "g1" else rm.G2
+    rows = []
+    bw = base_affine
+    for _ in range(_HOST_N_WINDOWS):
+        row = [None, bw]
+        for _ in range(2, 1 << _HOST_WINDOW_C):
+            row.append(host_ops.add(row[-1], bw))
+        rows.append(row)
+        for _ in range(_HOST_WINDOW_C):
+            bw = host_ops.double(bw)
+    return rows
+
+
+def host_windowed_mul(which: str, base_affine, k: int):
+    """k * base on host ("g1" | "g2") through the cached windowed table.
+    None base (infinity) and k == 0 mod order return None, matching the
+    refmath ladder."""
+    host_ops = rm.G1 if which == "g1" else rm.G2
+    k %= host_ops.order
+    if base_affine is None or k == 0:
+        return None
+    rows = _host_mul_table(which, base_affine)
+    mask = (1 << _HOST_WINDOW_C) - 1
+    acc = None
+    for w in range(_HOST_N_WINDOWS):
+        d = (k >> (w * _HOST_WINDOW_C)) & mask
+        if d:
+            acc = host_ops.add(acc, rows[w][d])
+    return acc
+
+
 def fixed_base_mul(which: str, scalars_std, chunk: int = 1 << 19):
     """scalars (n, 16) standard-form u32 -> (n, 3)+elem projective points
     scalar * G on the named generator ("g1" | "g2"). Chunked to bound peak

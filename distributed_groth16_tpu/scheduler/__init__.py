@@ -79,6 +79,9 @@ _BATCH_JOBS = _REG.counter(
     ("outcome",),
 )
 
+# kinds that batch onto a leased prover mesh; "verify" batches too but
+# leases nothing (an RLC fold is host pairing math + one device MSM —
+# docs/VERIFY.md), so it is special-cased in eligible()/_run_batch
 _BATCHABLE_KINDS = ("prove", "mpc_prove")
 
 
@@ -120,6 +123,15 @@ class BatchScheduler:
             self.cfg.batch_max,
             self.cfg.batch_linger_ms / 1000.0,
             slo_target_s=slo_target_s,
+            # verify buckets release on their own knobs (docs/VERIFY.md):
+            # folds amortize past any mesh-sized batch, so verify batches
+            # run bigger and linger shorter than prove batches
+            kind_overrides={
+                "verify": (
+                    self.cfg.verify_batch_max,
+                    self.cfg.verify_linger_ms / 1000.0,
+                )
+            },
         )
         self.devices = DevicePool(
             devices,
@@ -128,6 +140,15 @@ class BatchScheduler:
             breaker_cooldown_s=self.cfg.breaker_cooldown_s,
         )
         self.batch_prover = BatchProver(executor)
+        # the verification plane's batch runner (verifier/executor.py):
+        # shares the executor's PreparedVerifyingKey cache so per-job and
+        # batched verifies warm the same entries. Executors without a
+        # verifier (test stubs) just never see a verify bucket.
+        self.verify_runner = None
+        if getattr(executor, "verifier", None) is not None:
+            from ..verifier.executor import VerifyBatchRunner
+
+            self.verify_runner = VerifyBatchRunner(executor.verifier)
         self._meta: dict[str, tuple[int, int]] = {}  # cid -> (m, num_inputs)
         # solo-failure tally feeding the poisoned-job quarantine
         self._solo_failures: dict[str, int] = {}
@@ -196,12 +217,19 @@ class BatchScheduler:
     # -- admission (worker side) ---------------------------------------------
 
     def eligible(self, job) -> bool:
-        """Can this job ride the batched mesh path? Needs a batchable
-        kind and an inventory slice of 4l devices; anything else falls
-        back to the per-job executor funnel."""
+        """Can this job ride the batched path? Prove kinds need a
+        batchable kind and an inventory slice of 4l devices; verify jobs
+        batch whenever their own knob allows (they lease no mesh).
+        Anything else falls back to the per-job executor funnel."""
+        if self.cfg.batch_max <= 1:
+            return False
+        if job.kind == "verify":
+            return (
+                self.verify_runner is not None
+                and self.cfg.verify_batch_max > 1
+            )
         return (
-            self.cfg.batch_max > 1
-            and job.kind in _BATCHABLE_KINDS
+            job.kind in _BATCHABLE_KINDS
             and self.devices.capacity(4 * job.l) >= 1
         )
 
@@ -304,21 +332,29 @@ class BatchScheduler:
         jobs = self._admit(batch.jobs)
         if not jobs:
             return
-        lease = await self.devices.acquire(batch.key.n_parties)
-        # re-filter: the lease wait can last a whole prior batch, and a
-        # DELETE landing in that window already made the job terminal —
-        # mark_running after it would resurrect a CANCELLED job
-        jobs = self._admit(jobs)
-        if not jobs:
-            lease.release()
-            return
+        if batch.key.kind == "verify":
+            # no mesh lease: the fold runs host pairing math plus one
+            # batched MSM on the default device — concurrency is bounded
+            # by the inflight semaphore alone
+            lease = None
+        else:
+            lease = await self.devices.acquire(batch.key.n_parties)
+            # re-filter: the lease wait can last a whole prior batch, and
+            # a DELETE landing in that window already made the job
+            # terminal — mark_running after it would resurrect a
+            # CANCELLED job
+            jobs = self._admit(jobs)
+            if not jobs:
+                lease.release()
+                return
         cancelled = False
         try:
             for job in jobs:
                 job.mark_running()
                 self.queue.on_started(job)
             outcomes = await self._prove_bisecting(
-                jobs, batch.key, lease, lease.mesh
+                jobs, batch.key, lease,
+                lease.mesh if lease is not None else None,
             )
         except asyncio.CancelledError:
             # loop teardown mid-batch: never lose a job — record a
@@ -330,7 +366,8 @@ class BatchScheduler:
                 for job in jobs
             ]
         finally:
-            lease.release()
+            if lease is not None:
+                lease.release()
         for job, out in outcomes:
             self._solo_failures.pop(job.id, None)  # terminal either way
             if isinstance(out, JobCancelled):
@@ -387,10 +424,18 @@ class BatchScheduler:
         return final
 
     async def _bisect(self, jobs, key, lease, mesh, ctx: dict) -> list:
+        # kind dispatch: verify batches fold through the VerifyBatchRunner
+        # (no mesh); everything else proves through the BatchProver. Both
+        # share the [(job, outcome)] contract, so the whole fault ladder
+        # below — BatchFault halving, solo retries, quarantine — applies
+        # to either workload unchanged.
+        runner = (
+            self.verify_runner.run_batch
+            if key.kind == "verify"
+            else self.batch_prover.run_batch
+        )
         try:
-            raw = await asyncio.to_thread(
-                self.batch_prover.run_batch, jobs, key, mesh
-            )
+            raw = await asyncio.to_thread(runner, jobs, key, mesh)
         except asyncio.CancelledError:
             # task teardown, not a device fault: it must neither feed the
             # breaker nor enter the retry ladder — _run_batch terminal-
@@ -406,12 +451,18 @@ class BatchScheduler:
             else:
                 final.append((job, out))
         if faulted:
-            self.devices.report(lease, ok=False)
+            if lease is not None:
+                self.devices.report(lease, ok=False)
         elif any(not isinstance(o, BaseException) for _, o in final):
             # host-side-only outcomes (bad witness, cancel) say nothing
-            # about the devices — only a real proof counts as success
+            # about the devices — only a real proof counts as success.
+            # Verify batches hold no lease: the success flag still arms
+            # the quarantine verdict (a poisoned payload must not hide
+            # behind the everything-failed escape hatch), but there is
+            # no slice breaker to feed.
             ctx["succeeded"] = True
-            self.devices.report(lease, ok=True)
+            if lease is not None:
+                self.devices.report(lease, ok=True)
         if not faulted:
             return final
         if len(faulted) > 1:
@@ -462,6 +513,8 @@ class BatchScheduler:
             "enabled": True,
             "batchMax": self.cfg.batch_max,
             "lingerMs": self.cfg.batch_linger_ms,
+            "verifyBatchMax": self.cfg.verify_batch_max,
+            "verifyLingerMs": self.cfg.verify_linger_ms,
             "batchesDispatched": self.batches_dispatched,
             "jobsBatched": self.jobs_batched,
             "jobsPoisoned": self.jobs_poisoned,

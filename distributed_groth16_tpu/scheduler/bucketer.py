@@ -112,9 +112,16 @@ class Bucketer:
         clock=time.monotonic,
         slo_target_s: float = 0.0,
         age_of=None,
+        kind_overrides: dict | None = None,
     ):
         self.batch_max = max(1, batch_max)
         self.linger_s = max(0.0, linger_s)
+        # per-kind (batch_max, linger_s) overrides — one bucketer, one
+        # linger loop, different release knobs per workload: verify
+        # buckets (DG16_VERIFY_BATCH_MAX / DG16_VERIFY_LINGER_MS,
+        # docs/VERIFY.md) can afford far bigger batches than a mesh
+        # lease, so they must not ride the prove knobs
+        self.kind_overrides = dict(kind_overrides or {})
         self.clock = clock
         # deadline-aware release: <= 0 disables (unconditional linger).
         # `age_of` maps a job to its seconds-since-submission — injectable
@@ -128,13 +135,25 @@ class Bucketer:
     def __len__(self) -> int:
         return sum(len(b.jobs) for b in self._buckets.values())
 
-    def _linger_for(self, job) -> float:
-        """This job's linger allowance: the configured linger, shortened
-        by however much of its SLO wait budget the queue already spent."""
+    def batch_max_for(self, kind: str) -> int:
+        """The release threshold governing buckets of this kind."""
+        ov = self.kind_overrides.get(kind)
+        return self.batch_max if ov is None else max(1, ov[0])
+
+    def linger_s_for(self, kind: str) -> float:
+        """The base linger governing buckets of this kind."""
+        ov = self.kind_overrides.get(kind)
+        return self.linger_s if ov is None else max(0.0, ov[1])
+
+    def _linger_for(self, job, kind: str) -> float:
+        """This job's linger allowance: the configured (per-kind) linger,
+        shortened by however much of its SLO wait budget the queue
+        already spent."""
+        linger_s = self.linger_s_for(kind)
         if self.slo_target_s <= 0:
-            return self.linger_s
+            return linger_s
         budget = _SLO_WAIT_FRACTION * self.slo_target_s - self.age_of(job)
-        return min(self.linger_s, max(0.0, budget))
+        return min(linger_s, max(0.0, budget))
 
     def add(self, job, key: BucketKey) -> Batch | None:
         """Admit one job. Returns a released Batch when this admission
@@ -144,16 +163,18 @@ class Bucketer:
         b = self._buckets.get(key)
         if b is None:
             b = self._buckets[key] = _Bucket(
-                key=key, deadline=now + self._linger_for(job)
+                key=key, deadline=now + self._linger_for(job, key.kind)
             )
         else:
             # the TIGHTEST member deadline governs the bucket: an aged
             # job joining a fresh bucket must still release in time
-            b.deadline = min(b.deadline, now + self._linger_for(job))
+            b.deadline = min(
+                b.deadline, now + self._linger_for(job, key.kind)
+            )
         b.jobs.append(job)
         b.enqueued_at.append(now)
         _OCCUPANCY.labels(bucket=key.label).set(len(b.jobs))
-        if len(b.jobs) >= self.batch_max:
+        if len(b.jobs) >= self.batch_max_for(key.kind):
             return self._release(key, "full")
         return None
 

@@ -87,10 +87,12 @@ class JobCancelled(Exception):
 class ProofJob:
     """One queued proving request.
 
-    kind:    "prove" (single-prover) | "mpc_prove" (packed-MPC round)
-    fields:  the raw multipart fields of the submission (witness bytes or
-             JSON inputs) — parsed lazily by the executor, off the request
-             path.
+    kind:    "prove" (single-prover) | "mpc_prove" (packed-MPC round) |
+             "verify" (batched Groth16 verification, docs/VERIFY.md) |
+             "aggregate" (RLC proof-bundle attestation)
+    fields:  the raw multipart fields of the submission (witness bytes,
+             JSON inputs, or a proofs_file batch) — parsed lazily by the
+             executor, off the request path.
     """
 
     kind: str

@@ -35,6 +35,7 @@ from ..parallel.pss import PackedSharingParams
 from ..telemetry import aggregate, devmem, logbus, tracing, transfer
 from ..utils.config import ServiceConfig
 from ..utils.timers import phase
+from ..verifier.executor import VerifyExecutor
 from .crs_cache import CrsCache
 from .jobs import JobCancelled, JobState, ProofJob
 from .queue import JobQueue
@@ -61,6 +62,11 @@ class ProofExecutor:
             if crs_cache is not None
             else CrsCache(self.cfg.crs_cache_size)
         )
+        # the verification plane's executor (verifier/executor.py): owns
+        # the PreparedVerifyingKey cache the same way this executor owns
+        # the packed-CRS cache, sized by the same knob
+        self.verifier = VerifyExecutor(store)
+        self.verifier.pvk_cache.capacity = self.cfg.crs_cache_size
 
     # -- witness -------------------------------------------------------------
 
@@ -152,6 +158,11 @@ class ProofExecutor:
             )
 
     def _run(self, job: ProofJob) -> dict:
+        if job.kind in ("verify", "aggregate"):
+            # verification plane (docs/VERIFY.md): same tracing/cancel
+            # envelope, entirely different body — no witness, no CRS,
+            # no mesh
+            return self.verifier.run_job(job)
         timings = job.timings
         job.note_phase("load")
         with phase("load", timings):

@@ -207,6 +207,51 @@ def _pairing_miller_loop(log2n: int) -> KernelCase:
     return KernelCase(run, (), 1)
 
 
+@perf_kernel("verify_prepare_inputs", sizes=(6, 8), quick=(4,),
+             unit="proofs/sec")
+def _verify_prepare_inputs(log2n: int) -> KernelCase:
+    """The verification plane's device half (docs/VERIFY.md): B proofs'
+    public-input MSMs over one broadcast gamma_abc row as a single
+    batched kernel — the shape PvkCache + prepare_inputs_batched run."""
+    import jax.numpy as jnp
+
+    from ..ops.constants import R
+    from ..ops.curve import g1
+    from ..ops.msm import encode_scalars_std, msm_batched
+
+    b = 1 << log2n
+    n_inputs = 16  # gamma_abc rows per proof (1 + public inputs)
+    row = _distinct_bases("g1", 4)  # (16, 3) + elem, device
+    bases = jnp.broadcast_to(row, (b,) + row.shape)
+    rng = _rng(log2n, salt=6)
+    scalars = jnp.stack(
+        [
+            encode_scalars_std(_rand_ints(n_inputs, R, rng))
+            for _ in range(b)
+        ]
+    )
+    return KernelCase(msm_batched, (g1(), bases, scalars), b)
+
+
+@perf_kernel("verify_fold_miller", sizes=(2,), quick=(0,),
+             unit="proofs/sec", host=True)
+def _verify_fold_miller(log2n: int) -> KernelCase:
+    """The folded batch-verification equation (docs/VERIFY.md): ONE
+    multi-pairing of n+3 Miller loops + one final exponentiation covers
+    n proofs — vs 4n loops checked one by one. Generator pairs stand in
+    for real proofs: the Miller loop cost does not depend on the points."""
+    from ..ops.constants import G1_GENERATOR, G2_GENERATOR
+    from ..ops.pairing import multi_pairing
+
+    n = 1 << log2n
+    pairs = [(G2_GENERATOR, G1_GENERATOR)] * (n + 3)
+
+    def run():
+        multi_pairing(pairs)
+
+    return KernelCase(run, (), n)
+
+
 @perf_kernel("scalar_pack", sizes=(14,), quick=(12,), unit="scalars/sec",
              host=True)
 def _scalar_pack(log2n: int) -> KernelCase:

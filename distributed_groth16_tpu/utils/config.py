@@ -49,6 +49,9 @@ KNOBS: dict[str, str] = {
     "DG16_SCHED_POISON_RETRIES": "solo batch failures before quarantine",
     "DG16_BREAKER_THRESHOLD": "slice failures tripping its breaker, <=0 off",
     "DG16_BREAKER_COOLDOWN_S": "tripped-slice cooldown before half-open probe",
+    # verification plane (docs/VERIFY.md)
+    "DG16_VERIFY_BATCH_MAX": "verify jobs per RLC batch; <=1 per-job checks",
+    "DG16_VERIFY_LINGER_MS": "partial verify-bucket wait for batchmates",
     # telemetry (docs/OBSERVABILITY.md)
     "DG16_METRICS": "metrics kill switch (default on; 0/false off)",
     "DG16_TRACE": "print Start:/End: phase lines",
@@ -286,6 +289,14 @@ class SchedulerConfig:
         trip a device slice's circuit breaker; <= 0 disables breakers.
       * breaker_cooldown_s — seconds a tripped slice cools down before
         a half-open probe batch may test it again.
+      * verify_batch_max / verify_linger_ms — the verify-bucket overrides
+        (docs/VERIFY.md): kind="verify" jobs release at verify_batch_max
+        and linger verify_linger_ms, independent of the prove knobs,
+        because an RLC fold is milliseconds of host pairing math and can
+        afford a much bigger batch than a mesh lease can.
+        verify_batch_max <= 1 keeps verify jobs on the per-job executor
+        path even with the scheduler on. (The scheduler itself still
+        exists only when batch_max > 1.)
     """
 
     batch_max: int = 1
@@ -295,6 +306,8 @@ class SchedulerConfig:
     poison_retries: int = 2
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 30.0
+    verify_batch_max: int = 16
+    verify_linger_ms: float = 25.0
 
     @staticmethod
     def from_env() -> "SchedulerConfig":
@@ -306,6 +319,8 @@ class SchedulerConfig:
             poison_retries=env_int("DG16_SCHED_POISON_RETRIES", 2),
             breaker_threshold=env_int("DG16_BREAKER_THRESHOLD", 3),
             breaker_cooldown_s=env_float("DG16_BREAKER_COOLDOWN_S", 30.0),
+            verify_batch_max=env_int("DG16_VERIFY_BATCH_MAX", 16),
+            verify_linger_ms=env_float("DG16_VERIFY_LINGER_MS", 25.0),
         )
 
 

@@ -481,9 +481,12 @@ def test_eight_jobs_complete_in_at_most_two_batched_executions(circuit):
             resp = await client.get("/stats")
             stats = await resp.json()
             sched = stats["scheduler"]
-            assert sched["enabled"] and sched["batchesDispatched"] <= 2
-            assert sched["jobsBatched"] == 8
-            assert stats["queue"]["completed"] == 8
+            # 8 proves in <= 2 mesh executions (the `runs` bar above); the
+            # 8 /verify_proof wrapper jobs ride their own verify buckets
+            # and add at most one dispatch each (docs/VERIFY.md)
+            assert sched["enabled"] and sched["batchesDispatched"] <= 10
+            assert sched["jobsBatched"] == 16
+            assert stats["queue"]["completed"] == 16
 
             # the batch-size histogram is live on /metrics
             resp = await client.get("/metrics")

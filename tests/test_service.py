@@ -107,7 +107,9 @@ def test_eight_concurrent_jobs_two_workers(circuit):
 
             resp = await client.get("/stats")
             stats = await resp.json()
-            assert stats["queue"]["completed"] == 8
+            # 8 prove jobs + 8 verify jobs: /verify_proof is now a
+            # submit-and-await wrapper over the same queue (docs/VERIFY.md)
+            assert stats["queue"]["completed"] == 16
             assert stats["queue"]["failed"] == 0
             assert stats["queue"]["phases"]  # aggregate timings merged
 

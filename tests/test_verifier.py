@@ -1,0 +1,524 @@
+"""Verification-plane tests (verifier/ + the verify/aggregate job kinds;
+docs/VERIFY.md).
+
+Covers the acceptance ladder: (a) batch-of-N verdicts equal per-proof
+`verify()` for every valid/invalid pattern at small N, (b) an adversarial
+proof pair crafted against a KNOWN fold seed passes that fixed fold but
+is caught by fresh randomness and bisection, (c) the proof-level
+bisection isolates a bad proof at either end of a batch of 8, (d) an
+N=16 fold performs N+3 Miller loops — asserted through the
+`verify_pairings_saved_total` counter advancing by exactly 3N-3, (e) the
+batched device `prepare_inputs` matches the host path, (f) the host
+windowed-table fallback matches the plain ladder, (g) aggregation
+bundles round-trip and reject tampering — plus the service-level story:
+`POST /jobs/verify` / `POST /jobs/aggregate` through queue + journal-style
+lifecycle, the hardened legacy `/verify_proof` (typed 400, definite
+`isValid: false`), the scheduler's verify bucket path, and the fleet
+`top` per-kind footer.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from distributed_groth16_tpu.api.server import ApiServer
+from distributed_groth16_tpu.api.store import CircuitStore
+from distributed_groth16_tpu.frontend.ark_serde import proof_to_bytes
+from distributed_groth16_tpu.frontend.r1cs import mult_chain_circuit
+from distributed_groth16_tpu.frontend.readers import write_r1cs
+import importlib
+
+from distributed_groth16_tpu.models.groth16 import CompiledR1CS, verify
+from distributed_groth16_tpu.models.groth16.keys import Proof
+from distributed_groth16_tpu.models.groth16.prove import prove_single
+
+# the package __init__ re-exports the verify FUNCTION under the submodule's
+# name, so the module itself must come from sys.modules
+verify_mod = importlib.import_module(
+    "distributed_groth16_tpu.models.groth16.verify"
+)
+from distributed_groth16_tpu.ops import refmath as rm
+from distributed_groth16_tpu.ops.constants import G1_GENERATOR, R
+from distributed_groth16_tpu.ops.field import fr
+from distributed_groth16_tpu.telemetry import metrics as tm
+from distributed_groth16_tpu.utils.config import SchedulerConfig, ServiceConfig
+from distributed_groth16_tpu.verifier import (
+    InvalidProofError,
+    PreparedVerifyingKey,
+    PvkCache,
+    build_bundle,
+    check_bundle,
+    fold_scalars,
+    prepare_inputs_batched,
+    verify_batch,
+    verify_each,
+)
+from distributed_groth16_tpu.verifier.executor import parse_items
+
+from tests.test_service import _poll_terminal
+
+POLL_DEADLINE_S = 300.0
+N_PROOFS = 16
+N_DISTINCT = 4  # distinct (r, s) blindings; folds cycle them to N_PROOFS
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    """One saved circuit plus N_PROOFS valid proofs over the STORE's
+    deterministic setup — so unit folds and service jobs share one vk."""
+    cs = mult_chain_circuit(9, 7)  # the service-test e2e shape
+    r1cs, z = cs.finish()
+    root = str(tmp_path_factory.mktemp("verify_store"))
+    store = CircuitStore(root)
+    cid = store.save_circuit("vrf", write_r1cs(r1cs), b"")
+    _, pk = store.load(cid)
+    comp = CompiledR1CS(r1cs)
+    z_mont = fr().encode(z)
+    distinct = [
+        prove_single(pk, comp, z_mont, r=11 + i, s=13 + i)
+        for i in range(N_DISTINCT)
+    ]
+    proofs = [distinct[i % N_DISTINCT] for i in range(N_PROOFS)]
+    publics = [int(x) for x in z[1 : r1cs.num_instance]]
+    pvk = PreparedVerifyingKey.prepare(cid, pk.vk)
+    return {
+        "root": root,
+        "cid": cid,
+        "pk": pk,
+        "pvk": pvk,
+        "proofs": proofs,
+        "publics": publics,
+    }
+
+
+def _corrupt(proof: Proof) -> Proof:
+    """A structurally valid but FALSE proof: nudge C off the satisfying
+    point (still on-curve, still in-subgroup — serialization accepts it,
+    the pairing check does not)."""
+    return Proof(a=proof.a, b=proof.b, c=rm.G1.add(proof.c, G1_GENERATOR))
+
+
+def _payload(items) -> bytes:
+    return json.dumps(
+        [
+            {"proof": proof_to_bytes(p).hex(), "publicInputs": [str(x) for x in pub]}
+            for p, pub in items
+        ]
+    ).encode()
+
+
+# -- (a) fold verdicts == sequential verify(), every pattern -----------------
+
+
+def test_batch_matches_sequential_all_patterns(env):
+    pvk, proofs, publics = env["pvk"], env["proofs"], env["publics"]
+    n = 3
+    good = proofs[:n]
+    bad = [_corrupt(p) for p in good]
+    # the exact checker's verdict per member, computed ONCE — the
+    # per-mask sequential expectation is assembled from these
+    assert all(verify(pvk.vk, p, publics) for p in good)
+    assert not any(verify(pvk.vk, p, publics) for p in bad)
+    for mask in range(1 << n):
+        batch = [
+            good[i] if (mask >> i) & 1 else bad[i] for i in range(n)
+        ]
+        pubs = [publics] * n
+        expect = [bool((mask >> i) & 1) for i in range(n)]
+        assert verify_batch(pvk, batch, pubs) == all(expect)
+        assert verify_each(pvk, batch, pubs) == expect
+
+
+def test_empty_and_singleton_batches(env):
+    pvk, proofs, publics = env["pvk"], env["proofs"], env["publics"]
+    assert verify_batch(pvk, [], []) is True
+    assert verify_each(pvk, [], []) == []
+    assert verify_batch(pvk, [proofs[0]], [publics]) is True
+    assert verify_batch(pvk, [_corrupt(proofs[0])], [publics]) is False
+    with pytest.raises(ValueError):
+        verify_batch(pvk, [proofs[0]], [])
+
+
+# -- (b) adversarial pair against a KNOWN fold seed --------------------------
+
+
+def test_adversarial_fixed_seed_pair_caught_by_fresh_randomness(env):
+    """With r1, r2 known in advance, C1+D and C2-(r1/r2)D cancel inside
+    the folded delta term: the FIXED-seed fold passes while both proofs
+    are invalid. Fresh per-fold randomness (the production default) and
+    the bisection ladder both catch it — the reason `verify_batch`'s
+    `seed` parameter is for bundle re-checks and tests only."""
+    pvk, proofs, publics = env["pvk"], env["proofs"], env["publics"]
+    seed = b"\x2a" * 32
+    r1, r2 = fold_scalars(seed, 2)
+    d = rm.G1.scalar_mul(G1_GENERATOR, 123456789)
+    ratio = (r1 * pow(r2, -1, R)) % R
+    p1 = Proof(a=proofs[0].a, b=proofs[0].b, c=rm.G1.add(proofs[0].c, d))
+    p2 = Proof(
+        a=proofs[1].a,
+        b=proofs[1].b,
+        c=rm.G1.add(proofs[1].c, rm.G1.neg(rm.G1.scalar_mul(d, ratio))),
+    )
+    # both members are individually false...
+    assert not verify(pvk.vk, p1, publics)
+    assert not verify(pvk.vk, p2, publics)
+    # ...yet the fold the adversary predicted accepts the pair
+    assert verify_batch(pvk, [p1, p2], [publics] * 2, seed=seed) is True
+    # fresh randomness rejects it, and bisection names both members
+    assert verify_batch(pvk, [p1, p2], [publics] * 2) is False
+    assert verify_each(pvk, [p1, p2], [publics] * 2) == [False, False]
+
+
+# -- (c) bisection isolates a bad proof at either end ------------------------
+
+
+@pytest.mark.parametrize("bad_at", [0, 7])
+def test_bisection_isolates_single_bad_proof(env, bad_at):
+    pvk, proofs, publics = env["pvk"], env["proofs"], env["publics"]
+    batch = list(proofs[:8])
+    batch[bad_at] = _corrupt(batch[bad_at])
+    verdicts = verify_each(pvk, batch, [publics] * 8)
+    assert verdicts == [i != bad_at for i in range(8)]
+
+
+# -- (d) N=16 costs N+3 Miller loops (counter-asserted) ----------------------
+
+
+def test_fold_saves_3n_minus_3_pairings(env):
+    pvk, proofs, publics = env["pvk"], env["proofs"], env["publics"]
+    fam = tm.registry().family("verify_pairings_saved_total")
+    assert fam is not None
+    before = fam.value
+    assert verify_batch(pvk, proofs, [publics] * N_PROOFS) is True
+    # 4N per-proof Miller loops minus the N+3 folded ones: N=16 -> 45
+    assert fam.value - before == 4 * N_PROOFS - (N_PROOFS + 3) == 45
+
+
+# -- (e) batched device prepare_inputs == host path --------------------------
+
+
+def test_prepare_inputs_batched_matches_host(env):
+    pvk, publics = env["pvk"], env["publics"]
+    pubs = [publics, [x + 0 for x in publics], publics]
+    got = prepare_inputs_batched(pvk, pubs)
+    want = verify_mod.prepare_inputs(pvk.vk, publics)
+    assert len(got) == 3
+    for pt in got:
+        assert pt == want
+    with pytest.raises(ValueError):
+        prepare_inputs_batched(pvk, [publics + [1]])
+
+
+# -- (f) host windowed-table fallback ----------------------------------------
+
+
+def test_host_fixedbase_fallback_matches_ladder(env, monkeypatch):
+    from distributed_groth16_tpu.ops.fixedbase import host_windowed_mul
+
+    base = env["pk"].vk.gamma_abc_g1[1]
+    for k in (0, 1, 7, R - 1, 2**130 + 12345):
+        assert host_windowed_mul("g1", base, k) == rm.G1.scalar_mul(base, k)
+    # route prepare_inputs through the table path regardless of input
+    # count and require the identical point
+    want = verify_mod.prepare_inputs(env["pvk"].vk, env["publics"])
+    monkeypatch.setattr(verify_mod, "_FIXEDBASE_MIN_INPUTS", 1)
+    assert verify_mod.prepare_inputs(env["pvk"].vk, env["publics"]) == want
+
+
+# -- (g) aggregation bundles -------------------------------------------------
+
+
+def test_bundle_roundtrip_and_tamper(env):
+    pvk, proofs, publics = env["pvk"], env["proofs"], env["publics"]
+    bundle = build_bundle(pvk, proofs[:4], [publics] * 4)
+    assert bundle["count"] == 4 and bundle["circuitId"] == env["cid"]
+    assert len(bundle["pairs"]) == 4 + 3
+    assert check_bundle(bundle) is True
+    # the fold is re-derivable from the 32-byte seed alone
+    assert len(fold_scalars(bytes.fromhex(bundle["rSeed"]), 4)) == 4
+    # swap two folded G1 operands: points still deserialize, pairing fails
+    tampered = json.loads(json.dumps(bundle))
+    tampered["pairs"][0][1], tampered["pairs"][1][1] = (
+        tampered["pairs"][1][1],
+        tampered["pairs"][0][1],
+    )
+    assert check_bundle(tampered) is False
+    # a batch containing an invalid proof is not aggregable
+    with pytest.raises(ValueError):
+        build_bundle(
+            pvk, [proofs[0], _corrupt(proofs[1])], [publics] * 2
+        )
+    with pytest.raises(ValueError):
+        build_bundle(pvk, [], [])
+
+
+def test_pvk_cache_single_entry_and_stats(env):
+    cache = PvkCache(capacity=2)
+    calls = []
+
+    def factory():
+        calls.append(1)
+        return env["pvk"]
+
+    for _ in range(3):
+        assert cache.get_or_prepare(env["cid"], factory) is env["pvk"]
+    assert len(calls) == 1
+    s = cache.stats()
+    assert s["hits"] == 2 and s["misses"] == 1 and s["entries"] == 1
+
+
+def test_parse_items_rejects_malformed(env):
+    good = _payload([(env["proofs"][0], env["publics"])])
+    items = parse_items({"proofs_file": good})
+    assert len(items) == 1 and items[0][1] == env["publics"]
+    with pytest.raises(ValueError):
+        parse_items({})
+    with pytest.raises(ValueError):
+        parse_items({"proofs_file": b"not json"})
+    with pytest.raises(ValueError):
+        parse_items({"proofs_file": b"[]"})
+    with pytest.raises(ValueError, match="128 bytes"):
+        parse_items(
+            {"proofs_file": json.dumps([{"proof": "00" * 12}]).encode()}
+        )
+
+
+# -- service plane: /jobs/verify, /jobs/aggregate, /verify_proof -------------
+
+
+def _server(root, sched_cfg=None) -> ApiServer:
+    cfg = ServiceConfig(workers=2, queue_bound=64, crs_cache_size=8)
+    return ApiServer(CircuitStore(root), cfg, sched_cfg)
+
+
+def _run(coro):
+    asyncio.run(coro)
+
+
+def test_jobs_verify_route(env):
+    root, cid = env["root"], env["cid"]
+    payload = _payload([(p, env["publics"]) for p in env["proofs"][:3]])
+
+    async def run():
+        server = _server(root)
+        client = TestClient(TestServer(server.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/jobs/verify",
+                data={"circuit_id": cid, "proofs_file": payload},
+            )
+            body = await resp.json()
+            assert resp.status == 202, body
+            status = await _poll_terminal(client, body["jobId"])
+            assert status["state"] == "DONE", status
+            resp = await client.get(f"/jobs/{body['jobId']}/result")
+            result = await resp.json()
+            assert resp.status == 200, result
+            assert result["count"] == 3
+            assert result["verdicts"] == [True, True, True]
+            assert result["pairingsSaved"] == 6
+            assert "verify" in result["phases"]
+            # missing proofs_file is a typed 400, not a queued failure
+            # (bytes field keeps the request multipart like real clients)
+            resp = await client.post(
+                "/jobs/verify", data={"circuit_id": cid.encode()}
+            )
+            err = await resp.json()
+            assert resp.status == 400, err
+            assert err["error"]["type"] == "ValueError"
+            # verify jobs ride the same metrics spine as prove jobs
+            resp = await client.get("/stats")
+            stats = await resp.json()
+            assert stats["verifierCache"]["entries"] >= 1
+        finally:
+            await client.close()
+
+    _run(run())
+
+
+def test_jobs_verify_invalid_proof_fails_with_index(env):
+    root, cid = env["root"], env["cid"]
+    items = [
+        (env["proofs"][0], env["publics"]),
+        (_corrupt(env["proofs"][1]), env["publics"]),
+        (env["proofs"][2], env["publics"]),
+    ]
+
+    async def run():
+        server = _server(root)
+        client = TestClient(TestServer(server.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/jobs/verify",
+                data={"circuit_id": cid, "proofs_file": _payload(items)},
+            )
+            body = await resp.json()
+            assert resp.status == 202, body
+            status = await _poll_terminal(client, body["jobId"])
+            assert status["state"] == "FAILED", status
+            err = status["error"]
+            assert err["type"] == "InvalidProofError"
+            assert "index 1 of 3" in err["message"]
+        finally:
+            await client.close()
+
+    _run(run())
+
+
+def test_jobs_aggregate_route(env):
+    root, cid = env["root"], env["cid"]
+    payload = _payload([(p, env["publics"]) for p in env["proofs"][:4]])
+
+    async def run():
+        server = _server(root)
+        client = TestClient(TestServer(server.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/jobs/aggregate",
+                data={"circuit_id": cid, "proofs_file": payload},
+            )
+            body = await resp.json()
+            assert resp.status == 202, body
+            status = await _poll_terminal(client, body["jobId"])
+            assert status["state"] == "DONE", status
+            resp = await client.get(f"/jobs/{body['jobId']}/result")
+            result = await resp.json()
+            assert resp.status == 200, result
+            bundle = result["bundle"]
+            assert bundle["count"] == 4
+            assert check_bundle(bundle) is True
+        finally:
+            await client.close()
+
+    _run(run())
+
+
+def test_verify_proof_legacy_wrapper(env):
+    """The hardened legacy route: valid -> isValid true, invalid -> a
+    DEFINITE isValid false (HTTP 200), malformed -> typed 400 — never a
+    500 for client mistakes."""
+    root, cid = env["root"], env["cid"]
+    publics = [str(x) for x in env["publics"]]
+    good = list(proof_to_bytes(env["proofs"][0]))
+    bad = list(proof_to_bytes(_corrupt(env["proofs"][0])))
+
+    async def run():
+        server = _server(root)
+        client = TestClient(TestServer(server.app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/verify_proof",
+                json={"circuitId": cid, "proof": good, "publicInputs": publics},
+            )
+            body = await resp.json()
+            assert resp.status == 200 and body["isValid"] is True, body
+            assert body["circuitId"] == cid
+
+            resp = await client.post(
+                "/verify_proof",
+                json={"circuitId": cid, "proof": bad, "publicInputs": publics},
+            )
+            body = await resp.json()
+            assert resp.status == 200 and body["isValid"] is False, body
+
+            # truncated proof bytes: typed 400 with a sanitized DTO
+            resp = await client.post(
+                "/verify_proof",
+                json={"circuitId": cid, "proof": good[:16], "publicInputs": publics},
+            )
+            body = await resp.json()
+            assert resp.status == 400, body
+            assert body["error"]["type"] == "ValueError"
+            assert "message" in body["error"]
+
+            # missing circuitId: parse-phase 400
+            resp = await client.post("/verify_proof", json={"proof": good})
+            body = await resp.json()
+            assert resp.status == 400, body
+            assert body["error"]["phase"] == "parse"
+        finally:
+            await client.close()
+
+    _run(run())
+
+
+# -- scheduler path: verify buckets through admission + bisection ------------
+
+
+def test_scheduler_batches_verify_jobs_and_isolates_bad_one(env):
+    root, cid = env["root"], env["cid"]
+
+    def one_job_payload(i, corrupt=False):
+        p = _corrupt(env["proofs"][i]) if corrupt else env["proofs"][i]
+        return _payload([(p, env["publics"])])
+
+    async def run():
+        server = _server(
+            root,
+            SchedulerConfig(
+                batch_max=4,
+                batch_linger_ms=500.0,
+                verify_batch_max=4,
+                verify_linger_ms=500.0,
+            ),
+        )
+        assert server.scheduler is not None
+        assert server.scheduler.stats()["verifyBatchMax"] == 4
+        client = TestClient(TestServer(server.app()))
+        await client.start_server()
+        try:
+            async def submit(i, corrupt):
+                resp = await client.post(
+                    "/jobs/verify",
+                    data={
+                        "circuit_id": cid,
+                        "proofs_file": one_job_payload(i, corrupt),
+                    },
+                )
+                body = await resp.json()
+                assert resp.status == 202, body
+                return body["jobId"]
+
+            jids = await asyncio.gather(
+                *[submit(i, corrupt=(i == 2)) for i in range(4)]
+            )
+            outcomes = {}
+            for jid in jids:
+                outcomes[jid] = await _poll_terminal(client, jid)
+            states = [outcomes[j]["state"] for j in jids]
+            # the corrupted member fails ALONE; batchmates are DONE
+            assert states == ["DONE", "DONE", "FAILED", "DONE"], states
+            err = outcomes[jids[2]]["error"]
+            assert err["type"] == "InvalidProofError"
+            sched = server.scheduler.stats()
+            assert sched["jobsBatched"] >= 4
+        finally:
+            await client.close()
+
+    _run(run())
+
+
+# -- fleet `top` per-kind footer ---------------------------------------------
+
+
+def test_fleet_top_renders_per_kind_queue_depth():
+    from distributed_groth16_tpu.api.cli import format_fleet_top
+
+    frame = format_fleet_top(
+        {
+            "replicas": [],
+            "pending": 3,
+            "pendingByKind": {"verify": 2, "prove": 1},
+            "handoffs": 0,
+        },
+        "",
+    )
+    assert "pending[verify]=2" in frame
+    assert "pending[prove]=1" in frame
+    assert "pending=3" in frame
